@@ -1,0 +1,21 @@
+"""mx_rcnn_tpu — a TPU-native Faster R-CNN training & evaluation framework.
+
+A from-scratch JAX/XLA/Pallas rebuild with the capabilities of the MXNet
+reference `wfxiang08/mx-rcnn` (see SURVEY.md at the repo root):
+
+* Flax modules for the VGG16 / ResNet-50/101 backbones and the RPN / RCNN
+  heads (reference: ``rcnn/symbol/symbol_vgg.py``, ``symbol_resnet.py``).
+* Static-shape, jit-compatible re-designs of the reference's host-side /
+  CustomOp layers: ``anchor_target`` (ref ``rcnn/io/rpn.py — assign_anchor``),
+  ``proposal`` (ref ``rcnn/symbol/proposal.py`` + ``mx.symbol.Proposal``) and
+  ``proposal_target`` (ref ``rcnn/symbol/proposal_target.py``) — everything
+  from the input batch onward runs in ONE XLA program per step.
+* TPU data parallelism via ``jax.sharding.Mesh`` + ``shard_map`` with
+  ``lax.psum`` gradient sync over ICI (replacing MXNet ``kvstore='device'``).
+* Pallas TPU kernels for the hot non-conv ops (ROI pooling, NMS) replacing
+  the reference's CUDA/Cython kernels (ref ``rcnn/cython/``).
+"""
+
+__version__ = "0.1.0"
+
+from mx_rcnn_tpu.config import Config, generate_config  # noqa: F401

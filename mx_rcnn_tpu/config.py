@@ -1,0 +1,259 @@
+"""Immutable configuration system.
+
+Reference: ``rcnn/config.py`` — the reference keeps a global mutable easydict
+singleton (``config``/``default``) mutated by ``generate_config(network,
+dataset)`` and argparse overrides.  A hidden mutable global is hostile to XLA
+tracing and to reproducibility, so here the same three-level precedence
+(hardcoded defaults < network/dataset presets < CLI overrides) is realized
+with **frozen dataclasses**: ``generate_config`` returns a new immutable
+``Config`` that is threaded explicitly through every function.
+
+Key names and default values mirror the reference 1:1 wherever a reference
+key exists (``config.TRAIN.*``, ``config.TEST.*``, per-network and
+per-dataset dicts, ``default.*``) so they can be audited side by side.
+TPU-specific additions (shape buckets, compute dtype, padded sizes) are
+grouped at the bottom of each dataclass and commented as such.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Mirrors reference ``config.TRAIN``."""
+
+    # -- whole-pipeline switches --------------------------------------------
+    batch_images: int = 1          # images per device (ref: BATCH_IMAGES, per GPU)
+    end2end: bool = True           # ref: END2END
+    flip: bool = True              # ref: FLIP — append horizontally flipped roidb
+    shuffle: bool = True           # ref: SHUFFLE
+    aspect_grouping: bool = True   # ref: ASPECT_GROUPING — group wide/tall images
+
+    # -- R-CNN ROI sampling (ref rcnn/io/rcnn.py — sample_rois) --------------
+    batch_rois: int = 128          # ref: BATCH_ROIS — ROIs per image
+    fg_fraction: float = 0.25      # ref: FG_FRACTION — max fg fraction
+    fg_thresh: float = 0.5         # ref: FG_THRESH — fg IoU threshold
+    bg_thresh_hi: float = 0.5      # ref: BG_THRESH_HI
+    bg_thresh_lo: float = 0.0      # ref: BG_THRESH_LO
+
+    # -- bbox regression target normalization (ref: BBOX_* keys) -------------
+    bbox_regression_thresh: float = 0.5            # ref: BBOX_REGRESSION_THRESH
+    bbox_means: Tuple[float, ...] = (0.0, 0.0, 0.0, 0.0)   # ref: BBOX_MEANS
+    bbox_stds: Tuple[float, ...] = (0.1, 0.1, 0.2, 0.2)    # ref: BBOX_STDS
+
+    # -- RPN anchor target assignment (ref rcnn/io/rpn.py — assign_anchor) ---
+    rpn_batch_size: int = 256          # ref: RPN_BATCH_SIZE — anchors per image
+    rpn_fg_fraction: float = 0.5       # ref: RPN_FG_FRACTION
+    rpn_positive_overlap: float = 0.7  # ref: RPN_POSITIVE_OVERLAP
+    rpn_negative_overlap: float = 0.3  # ref: RPN_NEGATIVE_OVERLAP
+    rpn_clobber_positives: bool = False  # ref: RPN_CLOBBER_POSITIVES
+    rpn_allowed_border: int = 0        # ref: assign_anchor(allowed_border=0)
+    rpn_bbox_weights: Tuple[float, ...] = (1.0, 1.0, 1.0, 1.0)  # ref: RPN_BBOX_WEIGHTS
+
+    # -- RPN proposal generation at TRAIN time (ref mx.symbol.Proposal args) -
+    rpn_pre_nms_top_n: int = 12000  # ref: RPN_PRE_NMS_TOP_N
+    rpn_post_nms_top_n: int = 2000  # ref: RPN_POST_NMS_TOP_N
+    rpn_nms_thresh: float = 0.7     # ref: RPN_NMS_THRESH
+    rpn_min_size: int = 16          # ref: RPN_MIN_SIZE (pixels, at input scale)
+
+    # -- TPU additions -------------------------------------------------------
+    max_gt_boxes: int = 100        # static pad for per-image gt boxes
+    gt_append: bool = True         # append gt boxes to sampled ROI pool (ref does)
+
+
+@dataclass(frozen=True)
+class TestConfig:
+    """Mirrors reference ``config.TEST``."""
+
+    has_rpn: bool = True            # ref: HAS_RPN (True for end2end models)
+    batch_images: int = 1           # ref: BATCH_IMAGES
+    nms: float = 0.3                # ref: NMS — per-class NMS threshold at eval
+    score_thresh: float = 1e-3      # ref: pred_eval thresh
+    max_per_image: int = 100        # ref: pred_eval max_per_image
+    # RPN proposal generation at TEST time
+    rpn_pre_nms_top_n: int = 6000   # ref: RPN_PRE_NMS_TOP_N
+    rpn_post_nms_top_n: int = 300   # ref: RPN_POST_NMS_TOP_N
+    rpn_nms_thresh: float = 0.7     # ref: RPN_NMS_THRESH
+    rpn_min_size: int = 16          # ref: RPN_MIN_SIZE
+    # proposal-generation mode for alternate training (ref tools/test_rpn.py)
+    proposal_nms_thresh: float = 0.7
+    proposal_pre_nms_top_n: int = 20000
+    proposal_post_nms_top_n: int = 2000
+
+
+@dataclass(frozen=True)
+class NetworkConfig:
+    """Per-network preset. Mirrors the reference's per-network dict in
+    ``rcnn/config.py`` (pretrained prefix, anchor geometry, strides,
+    FIXED_PARAMS)."""
+
+    name: str = "resnet101"
+    pretrained: str = ""                 # path prefix of pretrained backbone
+    pretrained_epoch: int = 0
+    pixel_means: Tuple[float, ...] = (123.68, 116.779, 103.939)  # RGB; ref: PIXEL_MEANS
+    image_stride: int = 0                # ref: IMAGE_STRIDE (VGG 0, pad multiple)
+    rpn_feat_stride: int = 16            # ref: RPN_FEAT_STRIDE
+    rcnn_feat_stride: int = 16           # ref: RCNN_FEAT_STRIDE
+    anchor_scales: Tuple[int, ...] = (8, 16, 32)       # ref: ANCHOR_SCALES
+    anchor_ratios: Tuple[float, ...] = (0.5, 1.0, 2.0)  # ref: ANCHOR_RATIOS
+    rcnn_pooled_size: Tuple[int, int] = (14, 14)  # ref: VGG 7x7, ResNet 14x14
+    # Parameter-name prefixes frozen during training (ref: FIXED_PARAMS) and
+    # the larger set frozen in alternate-training shared-conv stages
+    # (ref: FIXED_PARAMS_SHARED).
+    fixed_params: Tuple[str, ...] = ("conv0", "stage1", "bn0", "bn_data")
+    fixed_params_shared: Tuple[str, ...] = (
+        "conv0", "stage1", "stage2", "stage3", "stage4", "bn0", "bn_data")
+    # -- TPU additions -------------------------------------------------------
+    depth: int = 101                     # resnet depth (50 / 101 / 152)
+    compute_dtype: str = "bfloat16"      # MXU-friendly activation dtype
+
+    @property
+    def num_anchors(self) -> int:
+        """Ref NUM_ANCHORS — derived, so it can never desynchronize from the
+        scale/ratio presets."""
+        return len(self.anchor_scales) * len(self.anchor_ratios)
+
+
+@dataclass(frozen=True)
+class DatasetConfig:
+    """Per-dataset preset. Mirrors the reference's per-dataset dict."""
+
+    name: str = "PascalVOC"
+    image_set: str = "2007_trainval"
+    test_image_set: str = "2007_test"
+    root_path: str = "data"
+    dataset_path: str = "data/VOCdevkit"
+    num_classes: int = 21                # ref: NUM_CLASSES (VOC 21 / COCO 81)
+
+
+@dataclass(frozen=True)
+class DefaultConfig:
+    """Mirrors reference ``default.*`` (training-schedule defaults)."""
+
+    frequent: int = 20            # ref: default.frequent — Speedometer period
+    kvstore: str = "device"       # kept for CLI parity; maps to DP-over-ICI
+    prefix: str = "model/e2e"
+    begin_epoch: int = 0
+    e2e_epoch: int = 10           # ref: default.e2e_epoch
+    e2e_lr: float = 0.001         # ref: default.e2e_lr
+    e2e_lr_step: str = "7"        # ref: default.e2e_lr_step (epoch for x0.1)
+    # alternate training stage schedules (ref: default.rpn_*/rcnn_*)
+    rpn_epoch: int = 8
+    rpn_lr: float = 0.001
+    rpn_lr_step: str = "6"
+    rcnn_epoch: int = 8
+    rcnn_lr: float = 0.001
+    rcnn_lr_step: str = "6"
+    # optimizer constants (ref train_end2end.py — train_net: sgd)
+    momentum: float = 0.9
+    wd: float = 0.0005
+    lr_factor: float = 0.1
+
+
+@dataclass(frozen=True)
+class BucketConfig:
+    """TPU addition (no reference equivalent — replaces the dynamic-shape
+    rebinding of ref ``rcnn/core/module.py — MutableModule``).
+
+    The reference resizes short side to SCALES[0][0]=600 capped at 1000 and
+    rebinds executors per batch shape.  XLA requires static shapes, so images
+    are resized the same way then padded into one of a small set of static
+    buckets; aspect-ratio grouping (ref ASPECT_GROUPING) maps each image to
+    the landscape or portrait bucket.
+    """
+
+    scale: int = 600            # ref: SCALES[0][0] — target short side
+    max_size: int = 1000        # ref: SCALES[0][1] — cap on long side
+    # (H, W) static buckets, multiples of 32 to keep feature grids aligned.
+    shapes: Tuple[Tuple[int, int], ...] = ((608, 1024), (1024, 608))
+
+
+@dataclass(frozen=True)
+class Config:
+    train: TrainConfig = field(default_factory=TrainConfig)
+    test: TestConfig = field(default_factory=TestConfig)
+    network: NetworkConfig = field(default_factory=NetworkConfig)
+    dataset: DatasetConfig = field(default_factory=DatasetConfig)
+    default: DefaultConfig = field(default_factory=DefaultConfig)
+    bucket: BucketConfig = field(default_factory=BucketConfig)
+
+    @property
+    def num_classes(self) -> int:
+        return self.dataset.num_classes
+
+    def replace(self, **kw: Any) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+    def replace_in(self, section: str, **kw: Any) -> "Config":
+        """Return a new Config with fields replaced inside one section,
+        e.g. ``cfg.replace_in('train', batch_images=2)``."""
+        return dataclasses.replace(
+            self, **{section: dataclasses.replace(getattr(self, section), **kw)})
+
+
+# ---------------------------------------------------------------------------
+# Network / dataset presets (ref rcnn/config.py — generate_config)
+# ---------------------------------------------------------------------------
+
+_NETWORKS: Mapping[str, Mapping[str, Any]] = {
+    "vgg": dict(
+        name="vgg",
+        depth=16,
+        rcnn_pooled_size=(7, 7),
+        # ref: VGG FIXED_PARAMS = ['conv1', 'conv2'] — freeze first two blocks
+        fixed_params=("conv1", "conv2"),
+        fixed_params_shared=("conv1", "conv2", "conv3", "conv4", "conv5"),
+        image_stride=0,
+    ),
+    "resnet50": dict(name="resnet50", depth=50, rcnn_pooled_size=(14, 14)),
+    "resnet101": dict(name="resnet101", depth=101, rcnn_pooled_size=(14, 14)),
+}
+
+_DATASETS: Mapping[str, Mapping[str, Any]] = {
+    "PascalVOC": dict(
+        name="PascalVOC",
+        image_set="2007_trainval",
+        test_image_set="2007_test",
+        dataset_path="data/VOCdevkit",
+        num_classes=21,
+    ),
+    "coco": dict(
+        name="coco",
+        image_set="train2017",
+        test_image_set="val2017",
+        dataset_path="data/coco",
+        num_classes=81,
+    ),
+}
+
+
+def generate_config(network: str = "resnet101", dataset: str = "PascalVOC",
+                    **overrides: Any) -> Config:
+    """Build an immutable Config from network+dataset presets.
+
+    Reference: ``rcnn/config.py — generate_config(network, dataset)`` which
+    mutates the global singleton; here a fresh Config is returned.
+    ``overrides`` may address nested fields with a ``section__field`` key,
+    e.g. ``generate_config('vgg', 'PascalVOC', train__batch_images=2)``.
+    """
+    if network not in _NETWORKS:
+        raise KeyError(f"unknown network {network!r}; have {sorted(_NETWORKS)}")
+    if dataset not in _DATASETS:
+        raise KeyError(f"unknown dataset {dataset!r}; have {sorted(_DATASETS)}")
+    cfg = Config(
+        network=NetworkConfig(**_NETWORKS[network]),
+        dataset=DatasetConfig(**_DATASETS[dataset]),
+    )
+    by_section: dict = {}
+    for key, val in overrides.items():
+        if "__" not in key:
+            raise KeyError(f"override {key!r} must be 'section__field'")
+        section, fname = key.split("__", 1)
+        by_section.setdefault(section, {})[fname] = val
+    for section, kw in by_section.items():
+        cfg = cfg.replace_in(section, **kw)
+    return cfg

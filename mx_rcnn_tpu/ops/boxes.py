@@ -1,0 +1,131 @@
+"""Box geometry: IoU, encode/decode, clipping.
+
+Reference: ``rcnn/processing/bbox_transform.py`` — ``bbox_overlaps`` (the
+pure-NumPy twin of the Cython ``rcnn/cython/bbox.pyx — bbox_overlaps_cython``
+hot loop), ``nonlinear_transform`` (a.k.a. ``bbox_transform``),
+``nonlinear_pred`` (a.k.a. ``bbox_pred``) and ``clip_boxes``.
+
+On TPU there is no reason for a native hot loop: the IoU matrix is a pair of
+broadcast min/max ops that XLA fuses and vectorizes onto the VPU, and it runs
+on HBM-resident data inside the same program as the conv net.
+
+All functions are dtype-polymorphic jnp and jit/vmap-safe.  Boxes are
+``(x1, y1, x2, y2)`` inclusive pixel corners (reference convention: width =
+x2 - x1 + 1).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def bbox_overlaps(boxes: jnp.ndarray, query_boxes: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise IoU.
+
+    Args:
+      boxes: (N, 4).
+      query_boxes: (K, 4).
+    Returns:
+      (N, K) IoU matrix.  Degenerate (zero/negative-area) boxes yield 0.
+
+    Reference: ``rcnn/processing/bbox_transform.py — bbox_overlaps`` /
+    ``rcnn/cython/bbox.pyx — bbox_overlaps_cython``.
+    """
+    b = boxes[:, None, :]      # (N, 1, 4)
+    q = query_boxes[None, :, :]  # (1, K, 4)
+    iw = jnp.minimum(b[..., 2], q[..., 2]) - jnp.maximum(b[..., 0], q[..., 0]) + 1.0
+    ih = jnp.minimum(b[..., 3], q[..., 3]) - jnp.maximum(b[..., 1], q[..., 1]) + 1.0
+    iw = jnp.maximum(iw, 0.0)
+    ih = jnp.maximum(ih, 0.0)
+    inter = iw * ih
+    area_b = (boxes[:, 2] - boxes[:, 0] + 1.0) * (boxes[:, 3] - boxes[:, 1] + 1.0)
+    area_q = (query_boxes[:, 2] - query_boxes[:, 0] + 1.0) * (
+        query_boxes[:, 3] - query_boxes[:, 1] + 1.0
+    )
+    union = area_b[:, None] + area_q[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-12), 0.0)
+
+
+def bbox_transform(ex_rois: jnp.ndarray, gt_rois: jnp.ndarray) -> jnp.ndarray:
+    """Encode gt boxes as regression deltas w.r.t. example (anchor/ROI) boxes.
+
+    Args:
+      ex_rois: (N, 4) anchors or proposals.
+      gt_rois: (N, 4) matched ground-truth boxes.
+    Returns:
+      (N, 4) targets (dx, dy, dw, dh).
+
+    Reference: ``rcnn/processing/bbox_transform.py — nonlinear_transform``.
+    """
+    ex_w = ex_rois[:, 2] - ex_rois[:, 0] + 1.0
+    ex_h = ex_rois[:, 3] - ex_rois[:, 1] + 1.0
+    ex_cx = ex_rois[:, 0] + 0.5 * (ex_w - 1.0)
+    ex_cy = ex_rois[:, 1] + 0.5 * (ex_h - 1.0)
+
+    gt_w = gt_rois[:, 2] - gt_rois[:, 0] + 1.0
+    gt_h = gt_rois[:, 3] - gt_rois[:, 1] + 1.0
+    gt_cx = gt_rois[:, 0] + 0.5 * (gt_w - 1.0)
+    gt_cy = gt_rois[:, 1] + 0.5 * (gt_h - 1.0)
+
+    # Reference adds 1e-14 to the denominators to dodge /0 on degenerate rois.
+    dx = (gt_cx - ex_cx) / (ex_w + 1e-14)
+    dy = (gt_cy - ex_cy) / (ex_h + 1e-14)
+    dw = jnp.log(jnp.maximum(gt_w, 1.0) / jnp.maximum(ex_w, 1.0))
+    dh = jnp.log(jnp.maximum(gt_h, 1.0) / jnp.maximum(ex_h, 1.0))
+    return jnp.stack([dx, dy, dw, dh], axis=-1)
+
+
+def bbox_pred(boxes: jnp.ndarray, box_deltas: jnp.ndarray) -> jnp.ndarray:
+    """Decode regression deltas back into boxes (inverse of bbox_transform).
+
+    Args:
+      boxes: (N, 4) anchors or proposals.
+      box_deltas: (N, 4*C) per-class deltas (C may be 1).
+    Returns:
+      (N, 4*C) decoded boxes.
+
+    Reference: ``rcnn/processing/bbox_transform.py — nonlinear_pred``.
+    """
+    w = boxes[:, 2] - boxes[:, 0] + 1.0
+    h = boxes[:, 3] - boxes[:, 1] + 1.0
+    cx = boxes[:, 0] + 0.5 * (w - 1.0)
+    cy = boxes[:, 1] + 0.5 * (h - 1.0)
+
+    dx = box_deltas[:, 0::4]
+    dy = box_deltas[:, 1::4]
+    # Cap dw/dh: exp() of a wild early-training delta otherwise overflows
+    # (py-faster-rcnn lineage caps at log(1000/16) ≈ 4.135).
+    dw = jnp.minimum(box_deltas[:, 2::4], 4.135166556742356)
+    dh = jnp.minimum(box_deltas[:, 3::4], 4.135166556742356)
+
+    pred_cx = dx * w[:, None] + cx[:, None]
+    pred_cy = dy * h[:, None] + cy[:, None]
+    pred_w = jnp.exp(dw) * w[:, None]
+    pred_h = jnp.exp(dh) * h[:, None]
+
+    x1 = pred_cx - 0.5 * (pred_w - 1.0)
+    y1 = pred_cy - 0.5 * (pred_h - 1.0)
+    x2 = pred_cx + 0.5 * (pred_w - 1.0)
+    y2 = pred_cy + 0.5 * (pred_h - 1.0)
+    # interleave back to (N, 4*C)
+    out = jnp.stack([x1, y1, x2, y2], axis=-1)  # (N, C, 4)
+    return out.reshape(boxes.shape[0], -1)
+
+
+def clip_boxes(boxes: jnp.ndarray, im_shape) -> jnp.ndarray:
+    """Clip (N, 4*C) boxes to image bounds [0, W-1] x [0, H-1].
+
+    Args:
+      boxes: (N, 4*C).
+      im_shape: (height, width) — python ints or traced scalars.
+
+    Reference: ``rcnn/processing/bbox_transform.py — clip_boxes``.
+    """
+    h, w = im_shape[0], im_shape[1]
+    n = boxes.shape[0]
+    b = boxes.reshape(n, -1, 4)
+    x1 = jnp.clip(b[..., 0], 0, w - 1.0)
+    y1 = jnp.clip(b[..., 1], 0, h - 1.0)
+    x2 = jnp.clip(b[..., 2], 0, w - 1.0)
+    y2 = jnp.clip(b[..., 3], 0, h - 1.0)
+    return jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1)

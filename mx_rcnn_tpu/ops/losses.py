@@ -1,0 +1,101 @@
+"""Loss functions.
+
+Reference: MXNet built-in C++ ops wired into the training symbols
+(``rcnn/symbol/symbol_vgg.py — get_vgg_train`` / ``symbol_resnet.py``):
+
+* ``mx.symbol.SoftmaxOutput(..., ignore_label=-1, use_ignore=True,
+  normalization='valid')`` for RPN classification,
+* ``mx.symbol.SoftmaxOutput(..., normalization='batch')`` for RCNN
+  classification,
+* ``mx.symbol.smooth_l1(scalar=sigma)`` wrapped in ``MakeLoss(grad_scale=
+  1/RPN_BATCH_SIZE or 1/BATCH_ROIS)`` for the two box-regression losses.
+
+On TPU these are three lines of jnp each; XLA fuses them into the backward
+pass — no custom ops needed.  All functions accept/return float32 (losses
+are accumulated in fp32 even when activations are bf16).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def smooth_l1(pred: jnp.ndarray, target: jnp.ndarray, sigma: float = 1.0) -> jnp.ndarray:
+    """Elementwise smooth-L1 (Huber) loss.
+
+    ``f(x) = 0.5 (sigma x)^2            if |x| < 1/sigma^2
+             |x| - 0.5/sigma^2          otherwise``
+
+    Reference: ``mx.symbol.smooth_l1(scalar=sigma)`` — RPN uses sigma=3,
+    RCNN uses sigma=1 (see §3.5 of SURVEY.md).
+    """
+    sigma2 = sigma * sigma
+    diff = (pred - target).astype(jnp.float32)
+    abs_diff = jnp.abs(diff)
+    return jnp.where(
+        abs_diff < 1.0 / sigma2,
+        0.5 * sigma2 * diff * diff,
+        abs_diff - 0.5 / sigma2,
+    )
+
+
+def softmax_cross_entropy_with_ignore(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    ignore_label: int = -1,
+    normalization: str = "valid",
+) -> jnp.ndarray:
+    """Softmax cross-entropy over the last axis with ignored labels.
+
+    Args:
+      logits: (..., C) raw scores.
+      labels: (...) int labels; entries equal to ``ignore_label`` contribute
+        zero loss and are excluded from 'valid' normalization.
+      normalization: 'valid' — divide by the count of non-ignored labels
+        (ref RPN loss); 'batch' — divide by the total label count (ref RCNN
+        loss with normalization='batch'); 'null' — plain sum.
+
+    Returns a scalar fp32 loss.
+    """
+    logits = logits.astype(jnp.float32)
+    mask = (labels != ignore_label)
+    safe_labels = jnp.where(mask, labels, 0).astype(jnp.int32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    nll = jnp.where(mask, nll, 0.0)
+    total = jnp.sum(nll)
+    if normalization == "valid":
+        return total / jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+    if normalization == "batch":
+        return total / float(labels.size)
+    if normalization == "null":
+        return total
+    raise ValueError(f"unknown normalization {normalization!r}")
+
+
+def weighted_smooth_l1(
+    pred: jnp.ndarray,
+    target: jnp.ndarray,
+    weight: jnp.ndarray,
+    sigma: float,
+    grad_norm: float,
+) -> jnp.ndarray:
+    """``sum(weight * smooth_l1(pred - target)) / grad_norm`` — the
+    ``smooth_l1 * bbox_weight → MakeLoss(grad_scale=1/N)`` pattern of the
+    reference training symbols.  RPN: sigma=3, grad_norm=RPN_BATCH_SIZE;
+    RCNN: sigma=1, grad_norm=BATCH_ROIS (per image).
+    """
+    loss = smooth_l1(pred, target, sigma) * weight.astype(jnp.float32)
+    return jnp.sum(loss) / float(grad_norm)
+
+
+def accuracy_with_ignore(
+    logits: jnp.ndarray, labels: jnp.ndarray, ignore_label: int = -1
+) -> jnp.ndarray:
+    """Masked accuracy — the metric twin of the CE loss (ref
+    ``rcnn/core/metric.py — RPNAccMetric / RCNNAccMetric``)."""
+    mask = labels != ignore_label
+    pred = jnp.argmax(logits, axis=-1)
+    correct = jnp.where(mask, (pred == labels).astype(jnp.float32), 0.0)
+    return jnp.sum(correct) / jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)

@@ -1,0 +1,174 @@
+"""Greedy non-maximum suppression with static shapes, jit-safe.
+
+Reference: ``rcnn/cython/cpu_nms.pyx``, ``rcnn/cython/gpu_nms.pyx`` +
+``rcnn/cython/nms_kernel.cu`` (the classic triangular-bitmask CUDA kernel,
+64-box blocks) and the wrapper selection in ``rcnn/processing/nms.py``
+(``py_nms_wrapper`` / ``cpu_nms_wrapper`` / ``gpu_nms_wrapper``).
+
+TPU-native design: the reference returns a *variable-length* keep list,
+which XLA cannot express.  Here NMS is reformulated as a fixed-shape
+computation:
+
+1. sort boxes by score (descending; invalid boxes sink to the end),
+2. tile-wise suppression sweep — for each tile of T sorted boxes, first
+   suppress by the *final* survivors of earlier tiles, then resolve the
+   within-tile greedy chain by fixed-point iteration (the suppressor of a
+   suppressed box does not count).  This reproduces exact sequential greedy
+   NMS semantics while doing O(K/T) vectorized (T, K) IoU sweeps on the VPU
+   instead of a length-K sequential loop,
+3. compact the survivors into a fixed-size index buffer with a cumsum
+   scatter (padded with -1).
+
+The whole thing lives inside the same XLA program as the network — no
+device→host bounce like the reference's Python ``proposal`` CustomOp.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.ops.boxes import bbox_overlaps
+
+_NEG = jnp.float32(-1e10)
+
+
+def _suppression_sweep(
+    boxes: jnp.ndarray,
+    alive_init: jnp.ndarray,
+    iou_threshold: float,
+    tile_size: int,
+) -> jnp.ndarray:
+    """Exact greedy NMS over score-sorted ``boxes``; returns the keep mask.
+
+    ``alive_init`` marks candidate boxes (invalid/padded boxes False).
+    """
+    k = boxes.shape[0]
+    t = tile_size
+    if k % t != 0:
+        raise ValueError(f"padded box count {k} must be a multiple of tile {t}")
+    num_tiles = k // t
+    # Within-tile suppressor relation: strictly-earlier boxes only.
+    tri = jnp.arange(t)[:, None] < jnp.arange(t)[None, :]  # tri[s, j]: s before j
+
+    def tile_body(i, keep):
+        start = i * t
+        tile_boxes = jax.lax.dynamic_slice(boxes, (start, 0), (t, 4))
+        tile_alive0 = jax.lax.dynamic_slice(keep, (start,), (t,))
+        iou = bbox_overlaps(tile_boxes, boxes)  # (t, k)
+        overlaps = iou > iou_threshold
+        # (a) suppression by final survivors of earlier tiles
+        earlier = (jnp.arange(k) < start) & keep
+        sup_prev = jnp.any(overlaps & earlier[None, :], axis=1)
+        alive0 = tile_alive0 & ~sup_prev
+        # (b) within-tile greedy chain, fixed-point iteration
+        iou_self = jax.lax.dynamic_slice(overlaps, (0, start), (t, t)) & tri
+
+        def fix_cond(state):
+            alive, prev, it = state
+            return jnp.logical_and(jnp.any(alive != prev), it < t)
+
+        def fix_body(state):
+            alive, _, it = state
+            sup = jnp.any(iou_self & alive[:, None], axis=0)
+            return alive0 & ~sup, alive, it + 1
+
+        alive, _, _ = jax.lax.while_loop(
+            fix_cond, fix_body, (alive0, jnp.zeros_like(alive0), 0)
+        )
+        return jax.lax.dynamic_update_slice(keep, alive, (start,))
+
+    return jax.lax.fori_loop(0, num_tiles, tile_body, alive_init)
+
+
+def _sorted_survivors(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    valid: Optional[jnp.ndarray],
+    iou_threshold: float,
+    tile_size: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, int, int]:
+    """Shared preamble of nms/nms_mask: mask invalid scores, pad to a tile
+    multiple, sort by score, run the suppression sweep.
+
+    Returns (order, keep, pad, tile) over the padded arrays, both in sorted
+    order.  Keeping this in one place keeps the training path (nms) and the
+    eval path (nms_mask) numerically identical.
+    """
+    k = boxes.shape[0]
+    boxes = boxes.astype(jnp.float32)
+    scores = scores.astype(jnp.float32)
+    if valid is not None:
+        scores = jnp.where(valid, scores, _NEG)
+    t = min(tile_size, max(k, 1))
+    pad = (-k) % t
+    if pad:
+        boxes = jnp.concatenate([boxes, jnp.zeros((pad, 4), jnp.float32)], axis=0)
+        scores = jnp.concatenate([scores, jnp.full((pad,), _NEG)], axis=0)
+    order = jnp.argsort(-scores)
+    keep = _suppression_sweep(boxes[order], scores[order] > _NEG / 2,
+                              iou_threshold, t)
+    return order, keep, pad, t
+
+
+@functools.partial(jax.jit, static_argnames=("iou_threshold", "max_output", "tile_size"))
+def nms(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    iou_threshold: float,
+    max_output: int,
+    valid: Optional[jnp.ndarray] = None,
+    tile_size: int = 256,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy NMS; returns up to ``max_output`` surviving indices by score.
+
+    Args:
+      boxes: (K, 4) in (x1, y1, x2, y2).
+      scores: (K,).
+      iou_threshold: suppression threshold (ref NMS_THRESH).
+      max_output: static output size.
+      valid: optional (K,) bool mask of real (non-padding) boxes.
+    Returns:
+      (indices, out_valid): indices (max_output,) int32 into the input arrays
+      ordered by descending score, padded with -1; out_valid (max_output,)
+      bool marks real outputs.
+    """
+    if boxes.shape[0] == 0:
+        return (jnp.full((max_output,), -1, jnp.int32),
+                jnp.zeros((max_output,), bool))
+    order, keep, _, t = _sorted_survivors(boxes, scores, valid,
+                                          iou_threshold, tile_size)
+    # Compact survivors (in score order) into a fixed buffer.
+    pos = jnp.cumsum(keep) - 1
+    emit = keep & (pos < max_output)
+    out_idx = jnp.full((max_output,), -1, dtype=jnp.int32)
+    out_idx = out_idx.at[jnp.where(emit, pos, max_output)].set(
+        order.astype(jnp.int32), mode="drop"
+    )
+    out_valid = out_idx >= 0
+    return out_idx, out_valid
+
+
+@functools.partial(jax.jit, static_argnames=("iou_threshold", "tile_size"))
+def nms_mask(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    iou_threshold: float,
+    valid: Optional[jnp.ndarray] = None,
+    tile_size: int = 256,
+) -> jnp.ndarray:
+    """Greedy NMS returning a keep mask in the *original* box order.
+
+    Used by the eval path (per-class NMS, ref ``rcnn/core/tester.py —
+    pred_eval``) where all candidates are postprocessed host-side.
+    """
+    k = boxes.shape[0]
+    if k == 0:
+        return jnp.zeros((0,), bool)
+    order, keep_sorted, pad, _ = _sorted_survivors(boxes, scores, valid,
+                                                   iou_threshold, tile_size)
+    keep = jnp.zeros((k + pad,), dtype=bool).at[order].set(keep_sorted)
+    return keep[:k]

@@ -1,0 +1,254 @@
+"""Training-target assignment: RPN anchor targets and RCNN proposal targets.
+
+Reference:
+* ``rcnn/io/rpn.py — assign_anchor`` (the anchor_target layer; run on the
+  **host** per batch inside ``AnchorLoader``, using Cython IoU),
+* ``rcnn/symbol/proposal_target.py — ProposalTargetOperator`` +
+  ``rcnn/io/rcnn.py — sample_rois`` (a mid-graph CustomOp that copies ROIs
+  to the host, samples with global NumPy RNG, and copies back).
+
+TPU-native design: both layers are pure jnp functions with **static
+shapes**, living inside the single jitted train step (the reference's
+host↔device bounces disappear; with 1 host core feeding 8 chips, host-side
+assignment would be the bottleneck anyway).  Dynamic-size constructs in the
+reference map to fixed-size equivalents:
+
+* variable in-image anchor subsets      → boolean masks over all N anchors,
+* ``npr.choice`` subsampling            → rank-of-uniform selection with a
+                                          ``jax.random.PRNGKey`` (explicit,
+                                          reproducible, per-image folds),
+* variable fg/bg sample counts          → exactly-``batch_rois`` slots chosen
+                                          by a priority top-k (fg first, then
+                                          bg, then padding that can only be
+                                          background).
+
+Labels use the reference's conventions: RPN labels {1 fg, 0 bg, -1 ignore};
+RCNN labels are class ids with 0 = background; bbox targets are
+class-specific ``(4·num_classes)`` with inside-weights, normalized by
+``BBOX_MEANS``/``BBOX_STDS``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.ops.boxes import bbox_overlaps, bbox_transform
+
+_INF = jnp.float32(3.4e38)
+
+
+def _rank_of_uniform(key: jax.Array, mask: jnp.ndarray) -> jnp.ndarray:
+    """Random rank (0-based) of each True element among the True elements.
+
+    The jit-safe equivalent of the reference's
+    ``npr.choice(inds, size=k, replace=False)`` disable-the-excess pattern:
+    element i of ``mask`` is "chosen into the first k" iff rank[i] < k.
+    False elements get rank >= count(True).
+    """
+    r = jax.random.uniform(key, mask.shape)
+    r = jnp.where(mask, r, _INF)
+    order = jnp.argsort(r)
+    ranks = jnp.zeros_like(order).at[order].set(jnp.arange(mask.shape[0]))
+    return ranks
+
+
+class AnchorTargets(NamedTuple):
+    labels: jnp.ndarray        # (N,) int32 in {1, 0, -1}
+    bbox_targets: jnp.ndarray  # (N, 4) fp32
+    bbox_weights: jnp.ndarray  # (N, 4) fp32
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "rpn_batch_size", "rpn_fg_fraction", "positive_overlap",
+        "negative_overlap", "clobber_positives", "allowed_border",
+        "bbox_weights",
+    ),
+)
+def anchor_target(
+    anchors: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    gt_valid: jnp.ndarray,
+    im_info: jnp.ndarray,
+    key: jax.Array,
+    rpn_batch_size: int = 256,
+    rpn_fg_fraction: float = 0.5,
+    positive_overlap: float = 0.7,
+    negative_overlap: float = 0.3,
+    clobber_positives: bool = False,
+    allowed_border: int = 0,
+    bbox_weights: Tuple[float, ...] = (1.0, 1.0, 1.0, 1.0),
+) -> AnchorTargets:
+    """RPN target assignment for one image (ref ``assign_anchor``).
+
+    Args:
+      anchors: (N, 4) all shifted anchors for the feature grid (constant).
+      gt_boxes: (G, 4) padded ground-truth boxes (input-image coordinates).
+      gt_valid: (G,) bool mask of real gt rows.
+      im_info: (3,) = (height, width, scale) of real image content.
+      key: per-image PRNG key for subsampling.
+    """
+    n = anchors.shape[0]
+    gt = gt_boxes.astype(jnp.float32)
+
+    # 1. keep only anchors inside the (real) image, ref allowed_border=0
+    inside = (
+        (anchors[:, 0] >= -allowed_border)
+        & (anchors[:, 1] >= -allowed_border)
+        & (anchors[:, 2] < im_info[1] + allowed_border)
+        & (anchors[:, 3] < im_info[0] + allowed_border)
+    )
+
+    # 2. IoU vs valid gt boxes
+    overlaps = bbox_overlaps(anchors, gt)  # (N, G)
+    overlaps = jnp.where(gt_valid[None, :], overlaps, 0.0)
+    max_overlap = jnp.max(overlaps, axis=1)
+    argmax_gt = jnp.argmax(overlaps, axis=1)
+    any_gt = jnp.any(gt_valid)
+
+    # per-gt best anchors (all ties), only among inside anchors
+    overlaps_in = jnp.where(inside[:, None], overlaps, -1.0)
+    gt_best = jnp.max(overlaps_in, axis=0)  # (G,)
+    is_gt_best = (
+        (overlaps_in == gt_best[None, :]) & gt_valid[None, :] & (gt_best[None, :] > 0)
+    ).any(axis=1)
+
+    # 3. label assignment in the reference's order (CLOBBER_POSITIVES=False:
+    #    negatives first, then gt-best, then threshold positives)
+    neg = inside & (max_overlap < negative_overlap)
+    pos = inside & (is_gt_best | (max_overlap >= positive_overlap)) & any_gt
+    if clobber_positives:
+        pos = pos & ~neg
+    else:
+        neg = neg & ~pos
+
+    # 4. subsample to rpn_batch_size with <= rpn_fg_fraction positives
+    kf, kb = jax.random.split(key)
+    num_fg_quota = int(rpn_fg_fraction * rpn_batch_size)
+    pos_rank = _rank_of_uniform(kf, pos)
+    pos_kept = pos & (pos_rank < num_fg_quota)
+    num_pos = jnp.sum(pos_kept.astype(jnp.int32))
+    neg_rank = _rank_of_uniform(kb, neg)
+    neg_kept = neg & (neg_rank < rpn_batch_size - num_pos)
+
+    labels = jnp.full((n,), -1, dtype=jnp.int32)
+    labels = jnp.where(neg_kept, 0, labels)
+    labels = jnp.where(pos_kept, 1, labels)
+
+    # 5. regression targets toward each anchor's best gt
+    matched_gt = gt[argmax_gt]
+    targets = bbox_transform(anchors.astype(jnp.float32), matched_gt)
+    w = jnp.asarray(bbox_weights, dtype=jnp.float32)
+    weights = jnp.where(pos_kept[:, None], w[None, :], 0.0)
+    targets = jnp.where(pos_kept[:, None], targets, 0.0)
+    return AnchorTargets(labels, targets, weights)
+
+
+class ProposalTargets(NamedTuple):
+    rois: jnp.ndarray          # (batch_rois, 4) fp32
+    labels: jnp.ndarray        # (batch_rois,) int32; 0 = background,
+                               # -1 = ignore (filler slot when the valid
+                               # fg+bg pool is smaller than batch_rois —
+                               # excluded from the classification loss)
+    bbox_targets: jnp.ndarray  # (batch_rois, 4*num_classes) fp32
+    bbox_weights: jnp.ndarray  # (batch_rois, 4*num_classes) fp32
+    fg_mask: jnp.ndarray       # (batch_rois,) bool
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_classes", "batch_rois", "fg_fraction", "fg_thresh",
+        "bg_thresh_hi", "bg_thresh_lo", "bbox_means", "bbox_stds", "gt_append",
+    ),
+)
+def proposal_target(
+    rois: jnp.ndarray,
+    roi_valid: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    gt_classes: jnp.ndarray,
+    gt_valid: jnp.ndarray,
+    key: jax.Array,
+    num_classes: int = 21,
+    batch_rois: int = 128,
+    fg_fraction: float = 0.25,
+    fg_thresh: float = 0.5,
+    bg_thresh_hi: float = 0.5,
+    bg_thresh_lo: float = 0.0,
+    bbox_means: Tuple[float, ...] = (0.0, 0.0, 0.0, 0.0),
+    bbox_stds: Tuple[float, ...] = (0.1, 0.1, 0.2, 0.2),
+    gt_append: bool = True,
+) -> ProposalTargets:
+    """Sample ROIs and build RCNN targets for one image.
+
+    Reference: ``proposal_target`` CustomOp → ``sample_rois``.  Ground-truth
+    boxes are appended to the candidate pool (as in the reference), sampling
+    keeps at most ``fg_fraction·batch_rois`` foreground ROIs, and bbox
+    regression targets are class-specific and mean/std-normalized
+    (``BBOX_NORMALIZATION_PRECOMPUTED``).
+    """
+    gt = gt_boxes.astype(jnp.float32)
+    if gt_append:
+        all_rois = jnp.concatenate([rois.astype(jnp.float32), gt], axis=0)
+        all_valid = jnp.concatenate([roi_valid, gt_valid], axis=0)
+    else:
+        all_rois = rois.astype(jnp.float32)
+        all_valid = roi_valid
+    # pad the candidate pool so the fixed-size top-k below is always legal
+    short = batch_rois - all_rois.shape[0]
+    if short > 0:
+        all_rois = jnp.concatenate([all_rois, jnp.zeros((short, 4), jnp.float32)])
+        all_valid = jnp.concatenate([all_valid, jnp.zeros((short,), bool)])
+
+    overlaps = bbox_overlaps(all_rois, gt)
+    overlaps = jnp.where(gt_valid[None, :], overlaps, 0.0)
+    max_ov = jnp.max(overlaps, axis=1)
+    argmax_gt = jnp.argmax(overlaps, axis=1)
+
+    fg = all_valid & (max_ov >= fg_thresh)
+    bg = all_valid & (max_ov < bg_thresh_hi) & (max_ov >= bg_thresh_lo)
+
+    kf, kb = jax.random.split(key)
+    fg_quota = int(round(fg_fraction * batch_rois))
+    fg_rank = _rank_of_uniform(kf, fg)
+    fg_sel = fg & (fg_rank < fg_quota)
+    num_fg = jnp.sum(fg_sel.astype(jnp.int32))
+    bg_rank = _rank_of_uniform(kb, bg)
+    bg_sel = bg & (bg_rank < batch_rois - num_fg)
+
+    # exactly batch_rois slots: selected fg first, then selected bg, then
+    # filler (only when fg+bg < batch_rois; labelled -1 = ignore, zero box
+    # weight).  Integer priority keys keep the three groups strictly ordered
+    # for any pool size (a float epsilon tie-break would overflow group gaps
+    # at the 2000-proposal training scale).
+    pool = all_rois.shape[0]
+    prio = jnp.where(
+        fg_sel, 3 * pool - fg_rank,
+        jnp.where(bg_sel, 2 * pool - bg_rank, pool - jnp.arange(pool)),
+    )
+    _, pick = jax.lax.top_k(prio, batch_rois)
+
+    sel_rois = all_rois[pick]
+    sel_fg = fg_sel[pick]
+    sel_bg = bg_sel[pick]
+    sel_gt = argmax_gt[pick]
+    labels = jnp.where(
+        sel_fg, gt_classes[sel_gt].astype(jnp.int32), jnp.where(sel_bg, 0, -1)
+    )
+
+    # normalized class-specific regression targets
+    t = bbox_transform(sel_rois, gt[sel_gt])
+    t = (t - jnp.asarray(bbox_means, jnp.float32)) / jnp.asarray(bbox_stds, jnp.float32)
+    onehot = jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)  # (B, C)
+    targets = (onehot[:, :, None] * t[:, None, :]).reshape(batch_rois, 4 * num_classes)
+    weights = jnp.broadcast_to(
+        onehot[:, :, None] * sel_fg[:, None, None], (batch_rois, num_classes, 4)
+    ).reshape(batch_rois, 4 * num_classes)
+    targets = targets * (labels > 0)[:, None]
+    weights = weights * (labels > 0)[:, None]
+    return ProposalTargets(sel_rois, labels, targets, weights, sel_fg)

@@ -1,0 +1,25 @@
+"""Test harness configuration.
+
+All tests run on CPU with 8 virtual XLA devices — the TPU-native answer to
+"test multi-chip without a cluster" (SURVEY.md §4): sharding/collective
+code is exercised on a real 8-device mesh, just a slow one.
+
+Must set the env vars before the first ``import jax`` anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

@@ -1,0 +1,85 @@
+"""Geometry unit tests vs tiny hand-computed cases and a NumPy oracle.
+
+The reference has no test suite (SURVEY.md §4); these are the golden tests
+it lacked, covering ``rcnn/processing/bbox_transform.py`` semantics.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.ops.boxes import bbox_overlaps, bbox_pred, bbox_transform, clip_boxes
+
+
+def np_overlaps(boxes, query):
+    """NumPy oracle: literal translation of the reference's bbox_overlaps."""
+    n, k = boxes.shape[0], query.shape[0]
+    out = np.zeros((n, k), dtype=np.float32)
+    for ki in range(k):
+        qa = (query[ki, 2] - query[ki, 0] + 1) * (query[ki, 3] - query[ki, 1] + 1)
+        for ni in range(n):
+            iw = min(boxes[ni, 2], query[ki, 2]) - max(boxes[ni, 0], query[ki, 0]) + 1
+            if iw > 0:
+                ih = min(boxes[ni, 3], query[ki, 3]) - max(boxes[ni, 1], query[ki, 1]) + 1
+                if ih > 0:
+                    ba = (boxes[ni, 2] - boxes[ni, 0] + 1) * (boxes[ni, 3] - boxes[ni, 1] + 1)
+                    out[ni, ki] = iw * ih / (ba + qa - iw * ih)
+    return out
+
+
+def test_overlaps_identity():
+    b = jnp.array([[0.0, 0.0, 9.0, 9.0]])
+    assert np.allclose(bbox_overlaps(b, b), 1.0)
+
+
+def test_overlaps_hand_case():
+    # 10x10 box vs 10x10 box shifted by 5: inter 5x10=50, union 150
+    a = jnp.array([[0.0, 0.0, 9.0, 9.0]])
+    b = jnp.array([[5.0, 0.0, 14.0, 9.0]])
+    got = np.asarray(bbox_overlaps(a, b))[0, 0]
+    assert abs(got - 50.0 / 150.0) < 1e-6
+
+
+def test_overlaps_disjoint_and_degenerate():
+    a = jnp.array([[0.0, 0.0, 4.0, 4.0], [10.0, 10.0, 5.0, 5.0]])  # 2nd degenerate
+    b = jnp.array([[100.0, 100.0, 110.0, 110.0]])
+    got = np.asarray(bbox_overlaps(a, b))
+    assert got[0, 0] == 0.0
+    assert got[1, 0] == 0.0
+
+
+def test_overlaps_vs_numpy_oracle(rng):
+    boxes = rng.uniform(0, 100, (40, 4)).astype(np.float32)
+    boxes[:, 2:] += boxes[:, :2]
+    query = rng.uniform(0, 100, (17, 4)).astype(np.float32)
+    query[:, 2:] += query[:, :2]
+    got = np.asarray(bbox_overlaps(jnp.array(boxes), jnp.array(query)))
+    want = np_overlaps(boxes, query)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_transform_pred_roundtrip(rng):
+    ex = rng.uniform(0, 200, (30, 4)).astype(np.float32)
+    ex[:, 2:] += ex[:, :2] + 5
+    gt = rng.uniform(0, 200, (30, 4)).astype(np.float32)
+    gt[:, 2:] += gt[:, :2] + 5
+    deltas = bbox_transform(jnp.array(ex), jnp.array(gt))
+    rec = bbox_pred(jnp.array(ex), deltas)
+    np.testing.assert_allclose(np.asarray(rec), gt, rtol=1e-3, atol=1e-2)
+
+
+def test_transform_zero_for_identical():
+    b = jnp.array([[10.0, 20.0, 50.0, 80.0]])
+    d = np.asarray(bbox_transform(b, b))
+    np.testing.assert_allclose(d, 0.0, atol=1e-6)
+
+
+def test_clip_boxes():
+    b = jnp.array([[-10.0, -5.0, 700.0, 300.0]])
+    out = np.asarray(clip_boxes(b, (256, 512)))
+    np.testing.assert_allclose(out, [[0.0, 0.0, 511.0, 255.0]])
+
+
+def test_clip_boxes_multiclass_layout():
+    b = jnp.array([[-1.0, -1.0, 600.0, 600.0, 5.0, 5.0, 10.0, 10.0]])
+    out = np.asarray(clip_boxes(b, (100, 100)))
+    np.testing.assert_allclose(out, [[0.0, 0.0, 99.0, 99.0, 5.0, 5.0, 10.0, 10.0]])

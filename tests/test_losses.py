@@ -1,0 +1,63 @@
+"""Loss function unit tests."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.ops.losses import (
+    accuracy_with_ignore,
+    smooth_l1,
+    softmax_cross_entropy_with_ignore,
+    weighted_smooth_l1,
+)
+
+
+def test_smooth_l1_quadratic_zone():
+    # sigma=1: |x| < 1 → 0.5 x^2
+    x = jnp.array([0.5])
+    np.testing.assert_allclose(smooth_l1(x, jnp.zeros(1), 1.0), 0.125, rtol=1e-6)
+
+
+def test_smooth_l1_linear_zone():
+    x = jnp.array([3.0])
+    np.testing.assert_allclose(smooth_l1(x, jnp.zeros(1), 1.0), 2.5, rtol=1e-6)
+
+
+def test_smooth_l1_sigma3_transition():
+    # sigma=3 → transition at 1/9; check both sides
+    s = 3.0
+    lo = jnp.array([0.05])
+    hi = jnp.array([0.5])
+    np.testing.assert_allclose(smooth_l1(lo, jnp.zeros(1), s), 0.5 * 9 * 0.05**2, rtol=1e-5)
+    np.testing.assert_allclose(smooth_l1(hi, jnp.zeros(1), s), 0.5 - 0.5 / 9, rtol=1e-5)
+
+
+def test_ce_ignore_and_normalization():
+    logits = jnp.array([[10.0, 0.0], [0.0, 10.0], [5.0, 5.0]])
+    labels = jnp.array([0, 1, -1])
+    loss_valid = softmax_cross_entropy_with_ignore(logits, labels, -1, "valid")
+    # two confident correct predictions → tiny loss; ignored row contributes 0
+    assert float(loss_valid) < 1e-3
+    loss_batch = softmax_cross_entropy_with_ignore(logits, labels, -1, "batch")
+    np.testing.assert_allclose(float(loss_batch), float(loss_valid) * 2 / 3, rtol=1e-5)
+
+
+def test_ce_uniform_logits():
+    logits = jnp.zeros((4, 21))
+    labels = jnp.array([0, 3, 7, 20])
+    loss = softmax_cross_entropy_with_ignore(logits, labels)
+    np.testing.assert_allclose(float(loss), np.log(21), rtol=1e-4)
+
+
+def test_weighted_smooth_l1():
+    pred = jnp.array([[1.0, 0.0], [0.0, 0.0]])
+    tgt = jnp.zeros((2, 2))
+    w = jnp.array([[1.0, 1.0], [0.0, 0.0]])
+    # only element (0,0) contributes: 0.5*1^2 = 0.5; /256
+    got = weighted_smooth_l1(pred, tgt, w, sigma=1.0, grad_norm=256)
+    np.testing.assert_allclose(float(got), 0.5 / 256, rtol=1e-6)
+
+
+def test_accuracy_with_ignore():
+    logits = jnp.array([[1.0, 0.0], [0.0, 1.0], [1.0, 0.0], [9.0, 0.0]])
+    labels = jnp.array([0, 1, 1, -1])
+    np.testing.assert_allclose(float(accuracy_with_ignore(logits, labels)), 2 / 3, rtol=1e-6)

@@ -1,0 +1,100 @@
+"""NMS tests: exact parity with a sequential greedy NumPy oracle.
+
+The oracle is the reference algorithm (``rcnn/cython/cpu_nms.pyx`` /
+``rcnn/processing/nms.py — py_nms``): sort by score, greedily keep the best
+remaining box and suppress everything above the IoU threshold.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from mx_rcnn_tpu.ops.nms import nms, nms_mask
+
+
+def greedy_nms_oracle(boxes, scores, thresh):
+    """Sequential greedy NMS; returns kept indices in score order."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    areas = (x2 - x1 + 1) * (y2 - y1 + 1)
+    order = scores.argsort()[::-1]
+    keep = []
+    while order.size > 0:
+        i = order[0]
+        keep.append(i)
+        xx1 = np.maximum(x1[i], x1[order[1:]])
+        yy1 = np.maximum(y1[i], y1[order[1:]])
+        xx2 = np.minimum(x2[i], x2[order[1:]])
+        yy2 = np.minimum(y2[i], y2[order[1:]])
+        w = np.maximum(0.0, xx2 - xx1 + 1)
+        h = np.maximum(0.0, yy2 - yy1 + 1)
+        inter = w * h
+        ovr = inter / (areas[i] + areas[order[1:]] - inter)
+        order = order[1:][ovr <= thresh]
+    return keep
+
+
+def random_boxes(rng, n, span=200):
+    b = rng.uniform(0, span, (n, 4)).astype(np.float32)
+    b[:, 2:] = b[:, :2] + rng.uniform(5, 80, (n, 2)).astype(np.float32)
+    s = rng.uniform(0.01, 1.0, (n,)).astype(np.float32)
+    return b, s
+
+
+def test_nms_hand_case():
+    boxes = jnp.array(
+        [
+            [0.0, 0.0, 99.0, 99.0],    # score .9  — kept
+            [5.0, 5.0, 104.0, 104.0],  # score .8  — IoU .73 with #0 → suppressed
+            [200.0, 200.0, 250.0, 250.0],  # score .7 — kept
+            [0.0, 0.0, 99.0, 99.0],    # score .6  — dup of #0 → suppressed
+        ]
+    )
+    scores = jnp.array([0.9, 0.8, 0.7, 0.6])
+    idx, valid = nms(boxes, scores, 0.5, 4)
+    assert list(np.asarray(idx[valid])) == [0, 2]
+    assert int(valid.sum()) == 2
+
+
+@pytest.mark.parametrize("n", [17, 64, 300, 777])
+@pytest.mark.parametrize("thresh", [0.3, 0.5, 0.7])
+def test_nms_matches_oracle(rng, n, thresh):
+    boxes, scores = random_boxes(rng, n)
+    want = greedy_nms_oracle(boxes, scores, thresh)
+    idx, valid = nms(jnp.array(boxes), jnp.array(scores), thresh, n, tile_size=64)
+    got = list(np.asarray(idx[valid]))
+    assert got == want
+
+
+def test_nms_max_output_truncates(rng):
+    boxes, scores = random_boxes(rng, 200)
+    want = greedy_nms_oracle(boxes, scores, 0.5)[:10]
+    idx, valid = nms(jnp.array(boxes), jnp.array(scores), 0.5, 10)
+    assert list(np.asarray(idx[valid])) == want
+
+
+def test_nms_respects_valid_mask(rng):
+    boxes, scores = random_boxes(rng, 50)
+    valid_in = np.ones(50, bool)
+    valid_in[25:] = False
+    want = greedy_nms_oracle(boxes[:25], scores[:25], 0.5)
+    idx, valid = nms(
+        jnp.array(boxes), jnp.array(scores), 0.5, 50, valid=jnp.array(valid_in)
+    )
+    got = list(np.asarray(idx[valid]))
+    assert got == want
+    assert all(g < 25 for g in got)
+
+
+def test_nms_mask_original_order(rng):
+    boxes, scores = random_boxes(rng, 120)
+    want = sorted(greedy_nms_oracle(boxes, scores, 0.4))
+    keep = np.asarray(nms_mask(jnp.array(boxes), jnp.array(scores), 0.4, tile_size=64))
+    assert sorted(np.flatnonzero(keep).tolist()) == want
+
+
+def test_nms_all_identical_boxes():
+    boxes = jnp.tile(jnp.array([[10.0, 10.0, 50.0, 50.0]]), (32, 1))
+    scores = jnp.linspace(1.0, 0.1, 32)
+    idx, valid = nms(boxes, scores, 0.5, 32)
+    assert int(valid.sum()) == 1
+    assert int(idx[0]) == 0

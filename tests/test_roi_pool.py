@@ -1,0 +1,69 @@
+"""ROIAlign / ROIPool tests vs small hand-checkable feature maps."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.ops.roi_pool import roi_align, roi_pool
+
+
+def ramp_feature(h, w, c=1):
+    """feature[y, x, 0] = y * w + x — linear in both axes."""
+    return jnp.arange(h * w, dtype=jnp.float32).reshape(h, w, 1).repeat(c, axis=2)
+
+
+def test_roi_align_constant_map():
+    feat = jnp.ones((16, 16, 3))
+    rois = jnp.array([[0.0, 0.0, 63.0, 63.0]])  # image coords, stride 4
+    out = roi_align(feat, rois, (7, 7), spatial_scale=0.25)
+    assert out.shape == (1, 7, 7, 3)
+    np.testing.assert_allclose(np.asarray(out), 1.0, atol=1e-5)
+
+
+def test_roi_align_linear_map_is_exact():
+    # bilinear sampling of a linear function reproduces it exactly at bin centers
+    h = w = 32
+    feat = ramp_feature(h, w)
+    # roi covering feature region [4, 20] x [8, 24] at stride 1
+    rois = jnp.array([[8.0, 4.0, 24.0, 20.0]])
+    ph = pw = 4
+    out = np.asarray(roi_align(feat, rois, (ph, pw), spatial_scale=1.0))[0, :, :, 0]
+    bin_h = 16.0 / ph
+    bin_w = 16.0 / pw
+    for p in range(ph):
+        for q in range(pw):
+            cy = 4.0 + (p + 0.5) * bin_h - 0.5
+            cx = 8.0 + (q + 0.5) * bin_w - 0.5
+            want = cy * w + cx
+            np.testing.assert_allclose(out[p, q], want, rtol=1e-5)
+
+
+def test_roi_align_batched_rois_shapes():
+    feat = jnp.ones((38, 64, 8))
+    rois = jnp.tile(jnp.array([[0.0, 0.0, 100.0, 100.0]]), (5, 1))
+    out = roi_align(feat, rois, (14, 14), 1.0 / 16)
+    assert out.shape == (5, 14, 14, 8)
+
+
+def test_roi_pool_max_semantics():
+    feat = jnp.zeros((8, 8, 1)).at[2, 3, 0].set(7.0).at[6, 6, 0].set(5.0)
+    rois = jnp.array([[0.0, 0.0, 7.0, 7.0]])  # whole map, stride 1
+    out = np.asarray(roi_pool(feat, rois, (2, 2), 1.0))[0, :, :, 0]
+    # quadrant maxes: TL contains (2,3)->7; BR contains (6,6)->5
+    assert out[0, 0] == 7.0
+    assert out[1, 1] == 5.0
+    assert out[0, 1] == 0.0 and out[1, 0] == 0.0
+
+
+def test_roi_pool_single_cell_roi():
+    feat = ramp_feature(8, 8)
+    rois = jnp.array([[3.0, 2.0, 3.0, 2.0]])  # one pixel at (y=2, x=3)
+    out = np.asarray(roi_pool(feat, rois, (2, 2), 1.0))[0]
+    # all bins cover the same single pixel (value 2*8+3=19)
+    np.testing.assert_allclose(out[..., 0], 19.0)
+
+
+def test_roi_align_bf16_passthrough():
+    feat = jnp.ones((16, 16, 4), dtype=jnp.bfloat16)
+    rois = jnp.array([[0.0, 0.0, 32.0, 32.0]])
+    out = roi_align(feat, rois, (7, 7), 0.25)
+    assert out.dtype == jnp.bfloat16

@@ -1,0 +1,178 @@
+"""anchor_target / proposal_target behavioral tests.
+
+These check the invariants the reference establishes in
+``rcnn/io/rpn.py — assign_anchor`` and ``rcnn/io/rcnn.py — sample_rois``:
+label semantics, sampling quotas, target normalization, gt-append.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.ops.anchors import generate_shifted_anchors
+from mx_rcnn_tpu.ops.boxes import bbox_transform
+from mx_rcnn_tpu.ops.targets import anchor_target, proposal_target
+
+KEY = jax.random.PRNGKey(0)
+
+
+def make_gt(boxes, max_gt=8):
+    g = np.zeros((max_gt, 4), np.float32)
+    v = np.zeros((max_gt,), bool)
+    for i, b in enumerate(boxes):
+        g[i] = b
+        v[i] = True
+    return jnp.array(g), jnp.array(v)
+
+
+def test_anchor_target_basic_labels():
+    # NB: the smallest default anchor is 96x184 px, so the test image must be
+    # a few hundred px for any anchor to be fully inside (ref allowed_border=0).
+    anchors = jnp.array(generate_shifted_anchors(20, 20, 16))
+    gt, gtv = make_gt([[100.0, 100.0, 220.0, 190.0]])
+    im_info = jnp.array([320.0, 320.0, 1.0])
+    out = anchor_target(anchors, gt, gtv, im_info, KEY)
+    labels = np.asarray(out.labels)
+    assert set(np.unique(labels)).issubset({-1, 0, 1})
+    # the gt's best anchor must be positive even if IoU < 0.7
+    assert (labels == 1).sum() >= 1
+    # quota: at most 256 participating, at most 128 positive
+    assert (labels >= 0).sum() <= 256
+    assert (labels == 1).sum() <= 128
+
+
+def test_anchor_target_outside_anchors_ignored():
+    anchors = jnp.array(generate_shifted_anchors(8, 8, 16))
+    gt, gtv = make_gt([[10.0, 10.0, 60.0, 60.0]])
+    im_info = jnp.array([64.0, 64.0, 1.0])  # only a corner of the grid inside
+    out = anchor_target(anchors, gt, gtv, im_info, KEY)
+    labels = np.asarray(out.labels)
+    a = np.asarray(anchors)
+    outside = (a[:, 0] < 0) | (a[:, 1] < 0) | (a[:, 2] >= 64) | (a[:, 3] >= 64)
+    assert (labels[outside] == -1).all()
+
+
+def test_anchor_target_weights_only_on_positives():
+    anchors = jnp.array(generate_shifted_anchors(20, 20, 16))
+    gt, gtv = make_gt([[20.0, 20.0, 140.0, 110.0]])
+    im_info = jnp.array([320.0, 320.0, 1.0])
+    out = anchor_target(anchors, gt, gtv, im_info, KEY)
+    labels = np.asarray(out.labels)
+    w = np.asarray(out.bbox_weights)
+    assert (labels == 1).sum() >= 1
+    assert (w[labels == 1] == 1.0).all()
+    assert (w[labels != 1] == 0.0).all()
+
+
+def test_anchor_target_negative_balance():
+    # no gt → everything inside should be negative, capped at 256
+    anchors = jnp.array(generate_shifted_anchors(20, 20, 16))
+    gt, gtv = make_gt([])
+    im_info = jnp.array([320.0, 320.0, 1.0])
+    out = anchor_target(anchors, gt, gtv, im_info, KEY)
+    labels = np.asarray(out.labels)
+    assert (labels == 1).sum() == 0
+    assert (labels == 0).sum() == 256
+
+
+def test_anchor_target_targets_match_transform():
+    anchors = jnp.array(generate_shifted_anchors(20, 20, 16))
+    gt_box = [30.0, 40.0, 170.0, 150.0]
+    gt, gtv = make_gt([gt_box])
+    im_info = jnp.array([320.0, 320.0, 1.0])
+    out = anchor_target(anchors, gt, gtv, im_info, KEY)
+    labels = np.asarray(out.labels)
+    pos = np.flatnonzero(labels == 1)
+    want = np.asarray(bbox_transform(anchors[pos], jnp.tile(jnp.array([gt_box]), (len(pos), 1))))
+    np.testing.assert_allclose(np.asarray(out.bbox_targets)[pos], want, rtol=1e-5, atol=1e-5)
+
+
+def _make_rois(n=64):
+    rng = np.random.RandomState(1)
+    r = rng.uniform(0, 150, (n, 4)).astype(np.float32)
+    r[:, 2:] = r[:, :2] + rng.uniform(10, 60, (n, 2))
+    return jnp.array(r), jnp.ones((n,), bool)
+
+
+def test_proposal_target_shapes_and_quota():
+    rois, rv = _make_rois()
+    gt, gtv = make_gt([[10.0, 10.0, 60.0, 60.0], [80.0, 80.0, 140.0, 140.0]])
+    gtc = jnp.array([3, 7] + [0] * 6)
+    out = proposal_target(rois, rv, gt, gtc, gtv, KEY, num_classes=21, batch_rois=128)
+    assert out.rois.shape == (128, 4)
+    assert out.labels.shape == (128,)
+    assert out.bbox_targets.shape == (128, 84)
+    # fg quota: at most 32 foreground (0.25 * 128)
+    assert int(out.fg_mask.sum()) <= 32
+    labels = np.asarray(out.labels)
+    # fg labels are the matched gt classes
+    assert set(labels[np.asarray(out.fg_mask)]).issubset({3, 7})
+
+
+def test_proposal_target_gt_append_guarantees_fg():
+    # no proposal overlaps the gt, but gt-append provides a perfect fg ROI
+    rois = jnp.tile(jnp.array([[200.0, 200.0, 250.0, 250.0]]), (32, 1))
+    rv = jnp.ones((32,), bool)
+    gt, gtv = make_gt([[10.0, 10.0, 60.0, 60.0]])
+    gtc = jnp.array([5] + [0] * 7)
+    out = proposal_target(rois, rv, gt, gtc, gtv, KEY, num_classes=21, batch_rois=128)
+    assert int(out.fg_mask.sum()) >= 1
+    fg_rois = np.asarray(out.rois)[np.asarray(out.fg_mask)]
+    np.testing.assert_allclose(fg_rois[0], [10.0, 10.0, 60.0, 60.0])
+
+
+def test_proposal_target_bbox_normalization():
+    # a fg roi exactly equal to its gt → raw deltas 0 → normalized = -mean/std
+    rois = jnp.tile(jnp.array([[10.0, 10.0, 60.0, 60.0]]), (16, 1))
+    rv = jnp.ones((16,), bool)
+    gt, gtv = make_gt([[10.0, 10.0, 60.0, 60.0]])
+    gtc = jnp.array([2] + [0] * 7)
+    means = (0.1, 0.1, 0.1, 0.1)
+    stds = (0.2, 0.2, 0.2, 0.2)
+    out = proposal_target(
+        rois, rv, gt, gtc, gtv, KEY, num_classes=21, batch_rois=128,
+        bbox_means=means, bbox_stds=stds,
+    )
+    fg = np.asarray(out.fg_mask)
+    tgt = np.asarray(out.bbox_targets)[fg][:, 8:12]  # class 2 slot
+    np.testing.assert_allclose(tgt, -0.5, atol=1e-5)
+    w = np.asarray(out.bbox_weights)[fg]
+    assert (w[:, 8:12] == 1.0).all()
+    assert (w[:, :8] == 0.0).all() and (w[:, 12:] == 0.0).all()
+
+
+def test_proposal_target_background_only():
+    rois, rv = _make_rois(32)
+    gt, gtv = make_gt([])
+    gtc = jnp.zeros((8,), jnp.int32)
+    out = proposal_target(rois, rv, gt, gtc, gtv, KEY, num_classes=21, batch_rois=128)
+    assert int(out.fg_mask.sum()) == 0
+    labels = np.asarray(out.labels)
+    # 32 genuine background rois; the 96 filler slots must be ignore (-1),
+    # never background (training on filler as bg poisons the classifier)
+    assert (labels == 0).sum() == 32
+    assert (labels == -1).sum() == 96
+    assert (np.asarray(out.bbox_weights) == 0).all()
+
+
+def test_proposal_target_no_fg_bg_confusion_at_scale():
+    # regression test for the priority-overflow bug: with a 2000-roi pool and
+    # many fg candidates, no IoU>=0.5 roi may be labelled background
+    rng = np.random.RandomState(3)
+    n = 2000
+    r = rng.uniform(0, 500, (n, 4)).astype(np.float32)
+    r[:, 2:] = r[:, :2] + rng.uniform(10, 100, (n, 2))
+    gt_box = np.array([100.0, 100.0, 300.0, 300.0], np.float32)
+    r[:300] = gt_box + rng.uniform(-8, 8, (300, 4)).astype(np.float32)  # fg-ish
+    rois = jnp.array(r)
+    rv = jnp.ones((n,), bool)
+    gt, gtv = make_gt([gt_box.tolist()])
+    gtc = jnp.array([4] + [0] * 7)
+    out = proposal_target(rois, rv, gt, gtc, gtv, KEY, num_classes=21, batch_rois=128)
+    from mx_rcnn_tpu.ops.boxes import bbox_overlaps
+    iou = np.asarray(bbox_overlaps(out.rois, jnp.array(gt_box)[None, :]))[:, 0]
+    labels = np.asarray(out.labels)
+    assert int(out.fg_mask.sum()) == 32
+    assert not ((labels == 0) & (iou >= 0.5)).any()
+    # selection is exhaustive: 32 fg + 96 bg, no filler needed
+    assert (labels >= 0).all()
