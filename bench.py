@@ -1,0 +1,94 @@
+"""Benchmark: ResNet-101 Faster R-CNN end-to-end training throughput.
+
+Prints ONE JSON line:
+  {"metric": "imgs_per_sec_per_chip", "value": N, "unit": "imgs/s", "vs_baseline": N}
+
+Baseline (BASELINE.md): the reference's community-reported throughput on a
+P100-class GPU for ResNet-101 @ short-side 600 is ~2-4 img/s; the north star
+is >= 1x P100 imgs/sec/chip, so vs_baseline is measured against 3.0 img/s
+(the midpoint).
+
+Config matches BASELINE.json config 5 per chip: ResNet-101 end2end, COCO
+81 classes, per-chip batch 2, 608x1024 bucket, bf16 activations, full train
+step (anchor targets, proposal NMS 12000->2000, ROI sampling, ROIAlign,
+backward, SGD) — all in one XLA program, synthetic data (the loader is not
+what's being measured).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.core.train import Batch, make_train_step, setup_training
+    from mx_rcnn_tpu.models import build_model
+
+    batch_images = 2
+    h, w = 608, 1024
+    cfg = generate_config("resnet101", "coco")
+    cfg = cfg.replace_in("train", batch_images=batch_images)
+    model = build_model(cfg)
+
+    key = jax.random.PRNGKey(0)
+    rng = np.random.RandomState(0)
+    g = cfg.train.max_gt_boxes
+    n_gt = 8  # typical COCO images carry ~7 annotations
+    gt_boxes = np.zeros((batch_images, g, 4), np.float32)
+    gt_classes = np.zeros((batch_images, g), np.int32)
+    gt_valid = np.zeros((batch_images, g), bool)
+    for i in range(batch_images):
+        xy = rng.uniform(0, 500, (n_gt, 2))
+        wh = rng.uniform(60, 300, (n_gt, 2))
+        gt_boxes[i, :n_gt, :2] = xy
+        gt_boxes[i, :n_gt, 2:] = np.minimum(xy + wh, [w - 1, h - 1])
+        gt_classes[i, :n_gt] = rng.randint(1, 81, n_gt)
+        gt_valid[i, :n_gt] = True
+    batch = Batch(
+        images=jnp.asarray(rng.randn(batch_images, h, w, 3), jnp.float32),
+        im_info=jnp.tile(jnp.array([[600.0, 1000.0, 1.0]]), (batch_images, 1)),
+        gt_boxes=jnp.asarray(gt_boxes),
+        gt_classes=jnp.asarray(gt_classes),
+        gt_valid=jnp.asarray(gt_valid),
+    )
+
+    print("initializing model...", file=sys.stderr)
+    state, tx = setup_training(model, cfg, key, (batch_images, h, w, 3),
+                               steps_per_epoch=10_000)
+    # donate the state: updates happen in place in HBM, no copy per step
+    step = jax.jit(make_train_step(model, cfg, tx), donate_argnums=(0,))
+
+    print("compiling + warmup...", file=sys.stderr)
+    t0 = time.time()
+    for _ in range(3):
+        state, metrics = step(state, batch, key)
+    jax.block_until_ready(state.params)
+    print(f"warmup done in {time.time() - t0:.1f}s; "
+          f"loss={float(metrics['loss']):.3f}", file=sys.stderr)
+
+    iters = 10
+    t0 = time.time()
+    for _ in range(iters):
+        state, metrics = step(state, batch, key)
+    jax.block_until_ready(state.params)
+    dt = time.time() - t0
+
+    imgs_per_sec = batch_images * iters / dt
+    p100_baseline = 3.0
+    out = {
+        "metric": "imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 3),
+        "unit": "imgs/s",
+        "vs_baseline": round(imgs_per_sec / p100_baseline, 3),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

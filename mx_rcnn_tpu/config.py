@@ -148,10 +148,12 @@ class DefaultConfig:
     rcnn_epoch: int = 8
     rcnn_lr: float = 0.001
     rcnn_lr_step: str = "6"
-    # optimizer constants (ref train_end2end.py — train_net: sgd)
+    # optimizer constants (ref train_end2end.py — train_net: sgd with
+    # momentum 0.9, wd 5e-4, elementwise clip_gradient=5)
     momentum: float = 0.9
     wd: float = 0.0005
     lr_factor: float = 0.1
+    clip_gradient: float = 5.0
 
 
 @dataclass(frozen=True)
@@ -211,6 +213,13 @@ _NETWORKS: Mapping[str, Mapping[str, Any]] = {
     ),
     "resnet50": dict(name="resnet50", depth=50, rcnn_pooled_size=(14, 14)),
     "resnet101": dict(name="resnet101", depth=101, rcnn_pooled_size=(14, 14)),
+    # test-only miniature network (see models/tiny.py); small anchors so
+    # tiny test images still contain in-image anchors
+    "tiny": dict(
+        name="tiny", depth=0, rcnn_pooled_size=(7, 7),
+        anchor_scales=(1, 2, 4), fixed_params=(), fixed_params_shared=(),
+        compute_dtype="float32",
+    ),
 }
 
 _DATASETS: Mapping[str, Mapping[str, Any]] = {

@@ -1,0 +1,3 @@
+"""Training/eval core — the TPU-native replacement for ``rcnn/core/``
+(MutableModule, metrics, callbacks, Predictor) and the optimizer wiring of
+``train_end2end.py``."""

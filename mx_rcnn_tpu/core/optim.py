@@ -1,0 +1,90 @@
+"""Optimizer construction: SGD + momentum + weight decay + step-decay LR,
+with reference ``FIXED_PARAMS`` freezing.
+
+Reference: ``train_end2end.py — train_net`` configures
+``optimizer='sgd'`` with ``momentum=0.9``, ``wd=0.0005``,
+``lr`` warm-from-config with an ``MultiFactorScheduler`` stepping ×0.1 at
+``lr_step`` epoch boundaries, and ``rcnn/core/module.py — MutableModule``
+excludes parameters whose name starts with any ``fixed_param_prefix`` from
+the update.
+
+TPU-native: one ``optax`` chain; freezing is an explicit gradient mask over
+the param tree (prefix match on the top-level module scope names, e.g.
+``backbone/conv1_*`` for VGG, ``backbone/stage1_*``/``backbone/bn*`` for
+ResNet).  MXNet applies weight decay to every parameter, so we do too.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from mx_rcnn_tpu.config import Config
+
+
+def lr_schedule(base_lr: float, lr_step_epochs: Sequence[int],
+                steps_per_epoch: int, factor: float = 0.1) -> optax.Schedule:
+    """Step-decay schedule (ref MultiFactorScheduler semantics: multiply lr
+    by ``factor`` when crossing each epoch boundary in ``lr_step``)."""
+    boundaries = {
+        int(e) * steps_per_epoch: factor for e in lr_step_epochs if int(e) > 0
+    }
+    return optax.piecewise_constant_schedule(base_lr, boundaries)
+
+
+def parse_lr_step(lr_step: str) -> Tuple[int, ...]:
+    """'7' or '5,7' → (7,) / (5, 7) (ref: comma-separated epoch list)."""
+    return tuple(int(s) for s in str(lr_step).split(",") if s.strip())
+
+
+def frozen_mask(params, fixed_prefixes: Iterable[str]):
+    """True = trainable, False = frozen.
+
+    A parameter is frozen when any path component starts with one of the
+    reference's FIXED_PARAMS prefixes (ref MutableModule fixed_param_prefix
+    matching by substring of the MXNet param name).
+    """
+    prefixes = tuple(fixed_prefixes)
+
+    def trainable(path: Tuple, _leaf) -> bool:
+        names = [getattr(k, "key", str(k)) for k in path]
+        for name in names:
+            if any(name.startswith(p) for p in prefixes):
+                return False
+        return True
+
+    return jax.tree_util.tree_map_with_path(trainable, params)
+
+
+def make_optimizer(
+    cfg: Config,
+    params,
+    steps_per_epoch: int,
+    base_lr: float | None = None,
+    lr_step: str | None = None,
+    frozen_prefixes: Sequence[str] | None = None,
+) -> optax.GradientTransformation:
+    """SGD(momentum, wd) with step decay and FIXED_PARAMS freezing.
+
+    ``params`` is only used to build the freeze mask pytree.
+    """
+    base_lr = cfg.default.e2e_lr if base_lr is None else base_lr
+    lr_step = cfg.default.e2e_lr_step if lr_step is None else lr_step
+    if frozen_prefixes is None:
+        frozen_prefixes = cfg.network.fixed_params
+    sched = lr_schedule(base_lr, parse_lr_step(lr_step), steps_per_epoch,
+                        cfg.default.lr_factor)
+    sgd = optax.chain(
+        # ref optimizer_params: elementwise clip_gradient=5 before update
+        optax.clip(cfg.default.clip_gradient),
+        optax.add_decayed_weights(cfg.default.wd),
+        optax.sgd(learning_rate=sched, momentum=cfg.default.momentum),
+    )
+    mask = frozen_mask(params, frozen_prefixes)
+    return optax.chain(
+        optax.masked(sgd, mask),
+        optax.masked(optax.set_to_zero(), jax.tree.map(lambda t: not t, mask)),
+    )

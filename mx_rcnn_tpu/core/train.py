@@ -1,0 +1,278 @@
+"""The end-to-end training step: ONE XLA program per iteration.
+
+Reference hot loop (SURVEY.md §3.1): ``MutableModule.fit`` →
+``forward_backward`` with two device→host→device CustomOp bounces
+(proposal, proposal_target) and host-side anchor assignment in
+``AnchorLoader``; gradients synced per-array through KVStore.
+
+TPU-native: everything from the (image, gt) batch onward — anchor targets,
+RPN losses, proposal NMS, ROI sampling, ROIAlign, RCNN losses, backward,
+SGD update — is traced into a single jitted function.  Data parallelism
+wraps this same function (see ``mx_rcnn_tpu/parallel``), with gradient
+``psum`` over ICI fused into the step.
+
+Loss layout matches the reference train symbol (§3.5):
+  rpn_cls:  softmax CE, ignore -1, normalized by valid anchors,
+  rpn_bbox: smooth_l1(sigma=3) · weights / RPN_BATCH_SIZE,
+  rcnn_cls: softmax CE over sampled ROIs (normalization='batch'),
+  rcnn_bbox: smooth_l1(sigma=1) · weights / BATCH_ROIS,
+all summed; the six training metrics of ``rcnn/core/metric.py`` are
+returned per step from the same activations.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Tuple
+
+import flax
+import jax
+import jax.numpy as jnp
+import optax
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.models.faster_rcnn import FasterRCNN
+from mx_rcnn_tpu.ops.losses import (
+    accuracy_with_ignore,
+    softmax_cross_entropy_with_ignore,
+    weighted_smooth_l1,
+)
+from mx_rcnn_tpu.ops.proposal import propose
+from mx_rcnn_tpu.ops.roi_pool import roi_align
+from mx_rcnn_tpu.ops.targets import anchor_target, proposal_target
+
+
+class TrainState(NamedTuple):
+    """Weights + optimizer slots (the analog of the Module's arg/aux params +
+    optimizer state; see ref ``rcnn/core/module.py``)."""
+
+    step: jnp.ndarray
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+
+class Batch(NamedTuple):
+    """Static-shape training batch (built host-side by the loader).
+
+    images: (N, H, W, 3) fp32, mean-subtracted RGB, padded into the bucket.
+    im_info: (N, 3) — (real_h, real_w, scale).
+    gt_boxes: (N, G, 4) padded gt boxes in input coordinates.
+    gt_classes: (N, G) int32 class ids (1..C-1; 0 is background).
+    gt_valid: (N, G) bool.
+    """
+
+    images: jnp.ndarray
+    im_info: jnp.ndarray
+    gt_boxes: jnp.ndarray
+    gt_classes: jnp.ndarray
+    gt_valid: jnp.ndarray
+
+
+def loss_and_metrics(
+    model: FasterRCNN,
+    params,
+    batch_stats,
+    batch: Batch,
+    key: jax.Array,
+    cfg: Config,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Full train-mode forward; returns (total_loss, metrics)."""
+    tr = cfg.train
+    variables = {"params": params, "batch_stats": batch_stats}
+    n = batch.images.shape[0]
+    k_anchor, k_prop, k_drop = jax.random.split(key, 3)
+
+    feat = model.apply(variables, batch.images, method=model.features)
+    rpn_cls, rpn_box = model.apply(variables, feat, method=model.rpn_raw)
+    _, fh, fw, _ = feat.shape
+    anchors = model.anchors_for(fh, fw)
+
+    # ---- RPN targets (in-graph; ref host-side assign_anchor) --------------
+    at = jax.vmap(
+        functools.partial(
+            anchor_target,
+            rpn_batch_size=tr.rpn_batch_size,
+            rpn_fg_fraction=tr.rpn_fg_fraction,
+            positive_overlap=tr.rpn_positive_overlap,
+            negative_overlap=tr.rpn_negative_overlap,
+            clobber_positives=tr.rpn_clobber_positives,
+            allowed_border=tr.rpn_allowed_border,
+            bbox_weights=tr.rpn_bbox_weights,
+        ),
+        in_axes=(None, 0, 0, 0, 0),
+    )(anchors, batch.gt_boxes, batch.gt_valid, batch.im_info,
+      jax.random.split(k_anchor, n))
+
+    rpn_cls32 = rpn_cls.astype(jnp.float32)
+    rpn_cls_loss = softmax_cross_entropy_with_ignore(
+        rpn_cls32.reshape(-1, 2), at.labels.reshape(-1), -1, "valid")
+    rpn_bbox_loss = weighted_smooth_l1(
+        rpn_box.astype(jnp.float32), at.bbox_targets, at.bbox_weights,
+        sigma=3.0, grad_norm=tr.rpn_batch_size * n)
+
+    # ---- proposals + ROI sampling (no gradient; ref Proposal/proposal_target
+    # CustomOps define no backward) ----------------------------------------
+    fg_scores = jax.nn.softmax(jax.lax.stop_gradient(rpn_cls32), axis=-1)[..., 1]
+    rpn_box_sg = jax.lax.stop_gradient(rpn_box.astype(jnp.float32))
+
+    def one_img(scores_i, box_i, info_i, gt_b, gt_c, gt_v, key_i):
+        rois, _, roi_valid = propose(
+            scores_i, box_i, anchors, info_i,
+            pre_nms_top_n=tr.rpn_pre_nms_top_n,
+            post_nms_top_n=tr.rpn_post_nms_top_n,
+            nms_thresh=tr.rpn_nms_thresh,
+            min_size=tr.rpn_min_size)
+        return proposal_target(
+            rois, roi_valid, gt_b, gt_c, gt_v, key_i,
+            num_classes=model.num_classes,
+            batch_rois=tr.batch_rois,
+            fg_fraction=tr.fg_fraction,
+            fg_thresh=tr.fg_thresh,
+            bg_thresh_hi=tr.bg_thresh_hi,
+            bg_thresh_lo=tr.bg_thresh_lo,
+            bbox_means=tr.bbox_means,
+            bbox_stds=tr.bbox_stds,
+            gt_append=tr.gt_append)
+
+    pt = jax.vmap(one_img)(
+        fg_scores, rpn_box_sg, batch.im_info, batch.gt_boxes,
+        batch.gt_classes, batch.gt_valid, jax.random.split(k_prop, n))
+
+    # ---- RCNN head on pooled ROI features ---------------------------------
+    pooled = jax.vmap(
+        lambda f, r: roi_align(f, r, model.pooled_size, 1.0 / model.feat_stride)
+    )(feat, pt.rois)  # (N, B, ph, pw, C)
+    flat = pooled.reshape((-1,) + pooled.shape[2:])
+    cls_logits, bbox_deltas = model.apply(
+        variables, flat, True, method=model.roi_head,
+        rngs={"dropout": k_drop})
+    cls_logits = cls_logits.astype(jnp.float32)
+    bbox_deltas = bbox_deltas.astype(jnp.float32)
+
+    labels = pt.labels.reshape(-1)
+    rcnn_cls_loss = softmax_cross_entropy_with_ignore(
+        cls_logits, labels, -1, "batch")
+    rcnn_bbox_loss = weighted_smooth_l1(
+        bbox_deltas, pt.bbox_targets.reshape(bbox_deltas.shape),
+        pt.bbox_weights.reshape(bbox_deltas.shape),
+        sigma=1.0, grad_norm=tr.batch_rois * n)
+
+    total = rpn_cls_loss + rpn_bbox_loss + rcnn_cls_loss + rcnn_bbox_loss
+
+    # the six reference metrics (rcnn/core/metric.py)
+    metrics = {
+        "rpn_acc": accuracy_with_ignore(rpn_cls32.reshape(-1, 2),
+                                        at.labels.reshape(-1)),
+        "rpn_logloss": rpn_cls_loss,
+        "rpn_l1loss": rpn_bbox_loss,
+        "rcnn_acc": accuracy_with_ignore(cls_logits, labels),
+        "rcnn_logloss": rcnn_cls_loss,
+        "rcnn_l1loss": rcnn_bbox_loss,
+        "loss": total,
+        "num_fg": pt.fg_mask.sum().astype(jnp.float32),
+    }
+    return total, metrics
+
+
+def init_variables(
+    model: FasterRCNN,
+    key: jax.Array,
+    image_shape: Tuple[int, int, int, int],
+):
+    """Initialize all model variables in ONE compiled program.
+
+    Returns (params, batch_stats).  (Ref analog: ``load_param`` + the
+    Normal-init of new layers in ``train_end2end.py``; pretrained weights
+    are grafted on top via ``utils/pretrained.py``.)"""
+    def _init(key):
+        images = jnp.zeros(image_shape, jnp.float32)
+        variables = model.init(key, images, method=model.features)
+        # also materialize RPN + head params with dummy shapes
+        feat = model.apply(variables, images, method=model.features)
+        k1, k2 = jax.random.split(key)
+        v_rpn = model.init(k1, feat, method=model.rpn_raw)
+        pooled = jnp.zeros((8,) + model.pooled_size + (feat.shape[-1],),
+                           jnp.float32)
+        v_head = model.init(k2, pooled, False, method=model.roi_head)
+        params = {**variables["params"], **v_rpn["params"], **v_head["params"]}
+        batch_stats = {
+            **variables.get("batch_stats", {}),
+            **v_rpn.get("batch_stats", {}),
+            **v_head.get("batch_stats", {}),
+        }
+        return flax.core.freeze(params).unfreeze(), batch_stats
+
+    # one compiled program instead of thousands of tunneled eager ops
+    return jax.jit(_init)(key)
+
+
+def init_state(
+    model: FasterRCNN,
+    key: jax.Array,
+    tx: optax.GradientTransformation,
+    image_shape: Tuple[int, int, int, int],
+) -> TrainState:
+    """init_variables + optimizer slots as a TrainState."""
+    params, batch_stats = init_variables(model, key, image_shape)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+    )
+
+
+def setup_training(
+    model: FasterRCNN,
+    cfg: Config,
+    key: jax.Array,
+    image_shape: Tuple[int, int, int, int],
+    steps_per_epoch: int,
+    **optimizer_kw,
+):
+    """One-stop builder: init variables ONCE, build the optimizer from the
+    resulting param tree (no throwaway second init), assemble the state.
+
+    Returns (state, tx).
+    """
+    from mx_rcnn_tpu.core.optim import make_optimizer
+
+    params, batch_stats = init_variables(model, key, image_shape)
+    tx = make_optimizer(cfg, params, steps_per_epoch, **optimizer_kw)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+    )
+    return state, tx
+
+
+def make_train_step(model: FasterRCNN, cfg: Config,
+                    tx: optax.GradientTransformation, axis_name: str | None = None):
+    """Build the jittable train step.  When ``axis_name`` is set the step is
+    meant to run under shard_map/pmap-style SPMD and gradients/metrics are
+    psum-averaged over that mesh axis (the TPU replacement for MXNet
+    ``kvstore='device'``)."""
+
+    def step(state: TrainState, batch: Batch, key: jax.Array
+             ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+        key = jax.random.fold_in(key, state.step)
+
+        def loss_fn(params):
+            return loss_and_metrics(model, params, state.batch_stats, batch,
+                                    key, cfg)
+
+        (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params)
+        if axis_name is not None:
+            grads = jax.lax.pmean(grads, axis_name)
+            metrics = jax.lax.pmean(metrics, axis_name)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(state.step + 1, params, state.batch_stats,
+                               opt_state)
+        return new_state, metrics
+
+    return step

@@ -1,0 +1,20 @@
+"""Model zoo: Flax re-designs of the reference's symbolic graphs.
+
+Reference: ``rcnn/symbol/symbol_vgg.py`` and ``rcnn/symbol/symbol_resnet.py``
+build ``mx.symbol.Symbol`` graphs (get_*_train / get_*_test / get_*_rpn /
+get_*_rcnn variants).  Here each network is a ``flax.linen`` module exposing
+``features`` (shared conv backbone), and the composite
+:class:`~mx_rcnn_tpu.models.faster_rcnn.FasterRCNN` wires backbone + RPN +
+RCNN head; the train/test/rpn-only/rcnn-only "symbol variants" of the
+reference become pure functions over the same module (see
+``mx_rcnn_tpu.core.train`` / ``mx_rcnn_tpu.core.tester``), so there is one
+set of weights and no graph duplication.
+
+Everything is NHWC (TPU-native layout), params fp32, activations optionally
+bfloat16 for the MXU.
+"""
+
+from mx_rcnn_tpu.models.faster_rcnn import FasterRCNN, build_model  # noqa: F401
+from mx_rcnn_tpu.models.resnet import ResNetBackbone, ResNetHead  # noqa: F401
+from mx_rcnn_tpu.models.rpn import RPNHead  # noqa: F401
+from mx_rcnn_tpu.models.vgg import VGGBackbone, VGGHead  # noqa: F401

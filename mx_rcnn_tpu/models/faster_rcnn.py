@@ -1,0 +1,171 @@
+"""The composite Faster R-CNN model.
+
+Reference: the train/test symbol builders ``get_vgg_train/test`` and
+``get_resnet_train/test`` (``rcnn/symbol/``).  The reference builds a
+separate static graph per phase; here ONE flax module exposes the pieces —
+``features`` (shared backbone), ``rpn_raw`` (RPN head), ``roi_head``
+(per-ROI classifier/regressor) — and the phase pipelines are pure
+functions: the training step (``core/train.py``) wires targets + losses, the
+predictor (``core/tester.py``) wires proposal + detection decoding, both
+around the same weights.
+
+``__call__`` implements the full test-mode forward (the equivalent of the
+reference test symbol): images → features → RPN → proposal → ROIAlign →
+head → (rois, cls_prob, bbox_deltas), entirely inside one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.models.resnet import ResNetBackbone, ResNetHead
+from mx_rcnn_tpu.models.rpn import RPNHead
+from mx_rcnn_tpu.models.vgg import VGGBackbone, VGGHead
+from mx_rcnn_tpu.ops.anchors import generate_shifted_anchors
+from mx_rcnn_tpu.ops.proposal import propose
+from mx_rcnn_tpu.ops.roi_pool import roi_align
+
+Dtype = Any
+
+
+class FasterRCNN(nn.Module):
+    """Backbone + RPN + RCNN head with reference-matching hyperparameters.
+
+    Fields mirror the per-network config block (ref ``rcnn/config.py``).
+    """
+
+    network: str = "resnet101"          # 'vgg' | 'resnet50' | 'resnet101'
+    num_classes: int = 21
+    anchor_scales: Tuple[int, ...] = (8, 16, 32)
+    anchor_ratios: Tuple[float, ...] = (0.5, 1.0, 2.0)
+    feat_stride: int = 16
+    pooled_size: Tuple[int, int] = (14, 14)
+    # test-time proposal params (ref config.TEST)
+    test_pre_nms_top_n: int = 6000
+    test_post_nms_top_n: int = 300
+    test_nms_thresh: float = 0.7
+    test_min_size: int = 16
+    dtype: Dtype = jnp.float32
+
+    @property
+    def num_anchors(self) -> int:
+        return len(self.anchor_scales) * len(self.anchor_ratios)
+
+    def setup(self):
+        if self.network == "vgg":
+            self.backbone = VGGBackbone(dtype=self.dtype)
+            self.head = VGGHead(dtype=self.dtype)
+        elif self.network in ("resnet50", "resnet101"):
+            depth = int(self.network.replace("resnet", ""))
+            self.backbone = ResNetBackbone(depth=depth, dtype=self.dtype)
+            self.head = ResNetHead(depth=depth, dtype=self.dtype)
+        elif self.network == "tiny":  # test-only miniature (models/tiny.py)
+            from mx_rcnn_tpu.models.tiny import TinyBackbone, TinyHead
+            self.backbone = TinyBackbone(dtype=self.dtype)
+            self.head = TinyHead(dtype=self.dtype)
+        else:
+            raise ValueError(f"unknown network {self.network!r}")
+        head_out_init = nn.initializers.normal(0.01)
+        self.rpn = RPNHead(num_anchors=self.num_anchors, dtype=self.dtype)
+        # ref: cls_score Normal(0.01), bbox_pred Normal(0.001)
+        self.cls_score = nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=jnp.float32,
+            kernel_init=head_out_init, name="cls_score")
+        self.bbox_pred = nn.Dense(
+            4 * self.num_classes, dtype=self.dtype, param_dtype=jnp.float32,
+            kernel_init=nn.initializers.normal(0.001), name="bbox_pred")
+
+    # ---- pieces (used by the train step) ----------------------------------
+
+    def features(self, images: jnp.ndarray) -> jnp.ndarray:
+        """(N, H, W, 3) mean-subtracted RGB → (N, H/16, W/16, C)."""
+        return self.backbone(images)
+
+    def rpn_raw(self, feat: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """feat → ((N, H*W*A, 2) cls logits, (N, H*W*A, 4) deltas)."""
+        return self.rpn(feat)
+
+    def roi_head(self, pooled: jnp.ndarray, train: bool = False
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """(R, ph, pw, C) pooled ROI features → ((R, classes) cls logits,
+        (R, 4*classes) bbox deltas)."""
+        x = self.head(pooled, train) if self.network == "vgg" else self.head(pooled)
+        return self.cls_score(x), self.bbox_pred(x)
+
+    def anchors_for(self, feat_h: int, feat_w: int) -> jnp.ndarray:
+        """Constant (H*W*A, 4) anchor grid for a static feature shape."""
+        return jnp.asarray(
+            generate_shifted_anchors(
+                feat_h, feat_w, self.feat_stride,
+                self.anchor_ratios, self.anchor_scales,
+            )
+        )
+
+    # ---- full test-mode forward (ref get_*_test symbol) -------------------
+
+    def __call__(self, images: jnp.ndarray, im_info: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, ...]:
+        """Test forward for a batch.
+
+        Args:
+          images: (N, H, W, 3) mean-subtracted RGB, static bucket shape.
+          im_info: (N, 3) = (real_h, real_w, scale) per image.
+        Returns:
+          rois (N, R, 4), roi_valid (N, R), cls_prob (N, R, classes),
+          bbox_deltas (N, R, 4*classes) — R = test_post_nms_top_n.
+        """
+        feat = self.features(images)
+        rpn_cls, rpn_box = self.rpn_raw(feat)
+        n, fh, fw, _ = feat.shape
+        anchors = self.anchors_for(fh, fw)
+        fg_scores = jax.nn.softmax(rpn_cls.astype(jnp.float32), axis=-1)[..., 1]
+
+        def one(scores_i, box_i, info_i):
+            return propose(
+                scores_i, box_i, anchors, info_i,
+                pre_nms_top_n=self.test_pre_nms_top_n,
+                post_nms_top_n=self.test_post_nms_top_n,
+                nms_thresh=self.test_nms_thresh,
+                min_size=self.test_min_size,
+            )
+
+        rois, _, roi_valid = jax.vmap(one)(fg_scores, rpn_box, im_info)
+
+        def pool_one(feat_i, rois_i):
+            return roi_align(feat_i, rois_i, self.pooled_size,
+                             1.0 / self.feat_stride)
+
+        pooled = jax.vmap(pool_one)(feat, rois)  # (N, R, ph, pw, C)
+        r = pooled.shape[1]
+        flat = pooled.reshape((n * r,) + pooled.shape[2:])
+        cls_logits, deltas = self.roi_head(flat, train=False)
+        cls_prob = jax.nn.softmax(cls_logits.astype(jnp.float32), axis=-1)
+        return (
+            rois,
+            roi_valid,
+            cls_prob.reshape(n, r, self.num_classes),
+            deltas.astype(jnp.float32).reshape(n, r, 4 * self.num_classes),
+        )
+
+
+def build_model(cfg: Config) -> FasterRCNN:
+    """Construct the model from a Config (ref generate_config wiring)."""
+    return FasterRCNN(
+        network=cfg.network.name,
+        num_classes=cfg.num_classes,
+        anchor_scales=cfg.network.anchor_scales,
+        anchor_ratios=cfg.network.anchor_ratios,
+        feat_stride=cfg.network.rpn_feat_stride,
+        pooled_size=cfg.network.rcnn_pooled_size,
+        test_pre_nms_top_n=cfg.test.rpn_pre_nms_top_n,
+        test_post_nms_top_n=cfg.test.rpn_post_nms_top_n,
+        test_nms_thresh=cfg.test.rpn_nms_thresh,
+        test_min_size=cfg.test.rpn_min_size,
+        dtype=jnp.bfloat16 if cfg.network.compute_dtype == "bfloat16" else jnp.float32,
+    )

@@ -1,0 +1,70 @@
+"""Shared layers.
+
+Reference: the BatchNorm convention of ``rcnn/symbol/symbol_resnet.py`` —
+every BN runs with ``use_global_stats=True`` (frozen running statistics),
+``eps=2e-5``, ``fix_gamma=False``, because detection fine-tuning uses batch
+sizes of 1–2 images where batch statistics would be garbage.  The gamma/beta
+of frozen stages are additionally excluded from the optimizer via
+``FIXED_PARAMS`` (here: an optax mask built in ``core.optim``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+class FrozenBatchNorm(nn.Module):
+    """Inference-mode BatchNorm: y = gamma * (x - mean) / sqrt(var + eps) + beta.
+
+    Running mean/var live in the ``batch_stats`` collection and are never
+    updated (ref ``use_global_stats=True``); gamma/beta are params so that
+    unfrozen stages can still learn an affine.
+    """
+
+    epsilon: float = 2e-5
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        mean = self.variable(
+            "batch_stats", "mean", nn.initializers.zeros, None, (c,), jnp.float32
+        )
+        var = self.variable(
+            "batch_stats", "var", nn.initializers.ones, None, (c,), jnp.float32
+        )
+        # fold into a single scale/shift in fp32, then cast once
+        inv = scale / jnp.sqrt(var.value + self.epsilon)
+        y = x.astype(jnp.float32) * inv + (bias - mean.value * inv)
+        return y.astype(self.dtype)
+
+
+def conv(
+    features: int,
+    kernel: Tuple[int, int] = (3, 3),
+    strides: Tuple[int, int] = (1, 1),
+    dtype: Dtype = jnp.float32,
+    name: Optional[str] = None,
+    use_bias: bool = True,
+    padding: str | Sequence[Tuple[int, int]] = "SAME",
+    kernel_init: Callable = nn.initializers.he_normal(),
+) -> nn.Conv:
+    """NHWC conv with fp32 params and configurable compute dtype."""
+    return nn.Conv(
+        features,
+        kernel,
+        strides=strides,
+        padding=padding,
+        use_bias=use_bias,
+        dtype=dtype,
+        param_dtype=jnp.float32,
+        kernel_init=kernel_init,
+        name=name,
+    )

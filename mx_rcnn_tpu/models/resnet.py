@@ -1,0 +1,124 @@
+"""ResNet-50/101 backbone and per-ROI head.
+
+Reference: ``rcnn/symbol/symbol_resnet.py`` — ``residual_unit`` (pre-activation
+bottleneck, BN eps 2e-5 with frozen statistics), ``get_resnet_conv`` (bn_data
+→ conv0 7x7/2 → bn0 → pool → stages of [3, 4, 23] units for ResNet-101,
+ending at stride 16), and the train/test symbols which run the final
+2048-filter stage (3 units, first stride 2) **per ROI after ROIPooling**,
+followed by global average pooling — the ResNet head has no fc6/fc7.
+
+Split here into :class:`ResNetBackbone` (shared, stride 16) and
+:class:`ResNetHead` (applied to pooled 14x14 ROI features).  NHWC, params
+fp32, compute dtype configurable (bf16 for the MXU).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.models.layers import FrozenBatchNorm, conv
+
+Dtype = Any
+
+# units per stage, as in the reference's resnet() factory
+STAGE_UNITS = {
+    50: (3, 4, 6, 3),
+    101: (3, 4, 23, 3),
+    152: (3, 8, 36, 3),
+}
+
+
+class BottleneckUnit(nn.Module):
+    """Pre-activation bottleneck (ref ``residual_unit`` with bottle_neck=True):
+    bn→relu→1x1 conv(f/4) → bn→relu→3x3 conv(f/4, stride) → bn→relu→1x1
+    conv(f), with a 1x1 projection shortcut from the first activation when
+    shape changes."""
+
+    filters: int
+    stride: int = 1
+    dim_match: bool = True
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        act1 = nn.relu(FrozenBatchNorm(dtype=self.dtype, name="bn1")(x))
+        c1 = conv(self.filters // 4, (1, 1), dtype=self.dtype, use_bias=False,
+                  name="conv1")(act1)
+        act2 = nn.relu(FrozenBatchNorm(dtype=self.dtype, name="bn2")(c1))
+        c2 = conv(self.filters // 4, (3, 3), (self.stride, self.stride),
+                  dtype=self.dtype, use_bias=False, name="conv2")(act2)
+        act3 = nn.relu(FrozenBatchNorm(dtype=self.dtype, name="bn3")(c2))
+        # zero-init the residual branch output: with frozen identity BN a
+        # he-init conv3 doubles activation variance per unit (2^33 by the end
+        # of ResNet-101) and from-scratch training NaNs on step one.  The
+        # reference never hits this because it always starts from ImageNet
+        # weights with real BN statistics; zero init makes random init sane
+        # and is overwritten anyway when pretrained weights load.
+        c3 = conv(self.filters, (1, 1), dtype=self.dtype, use_bias=False,
+                  kernel_init=nn.initializers.zeros, name="conv3")(act3)
+        if self.dim_match:
+            shortcut = x
+        else:
+            shortcut = conv(self.filters, (1, 1), (self.stride, self.stride),
+                            dtype=self.dtype, use_bias=False, name="sc")(act1)
+        return c3 + shortcut
+
+
+def _stage(x: jnp.ndarray, filters: int, units: int, stride: int,
+           dtype: Dtype, name_prefix: str) -> jnp.ndarray:
+    for u in range(units):
+        x = BottleneckUnit(
+            filters=filters,
+            stride=stride if u == 0 else 1,
+            dim_match=False if u == 0 else True,
+            dtype=dtype,
+            name=f"{name_prefix}_unit{u + 1}",
+        )(x)
+    return x
+
+
+class ResNetBackbone(nn.Module):
+    """Shared conv feature extractor, stride 16 (ref ``get_resnet_conv``).
+
+    Input: (N, H, W, 3) raw pixels minus PIXEL_MEANS (RGB).
+    Output: (N, H/16, W/16, 1024).
+    """
+
+    depth: int = 101
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        units = STAGE_UNITS[self.depth]
+        x = x.astype(self.dtype)
+        # ref: bn_data (BatchNorm on raw input, fix_gamma=True)
+        x = FrozenBatchNorm(dtype=self.dtype, name="bn_data")(x)
+        x = conv(64, (7, 7), (2, 2), dtype=self.dtype, use_bias=False,
+                 name="conv0")(x)
+        x = nn.relu(FrozenBatchNorm(dtype=self.dtype, name="bn0")(x))
+        x = nn.max_pool(x, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
+        x = _stage(x, 256, units[0], 1, self.dtype, "stage1")
+        x = _stage(x, 512, units[1], 2, self.dtype, "stage2")
+        x = _stage(x, 1024, units[2], 2, self.dtype, "stage3")
+        return x
+
+
+class ResNetHead(nn.Module):
+    """Per-ROI head: the 2048-filter stage + global average pool
+    (ref train/test symbols: stage applied after ROIPooling(14,14),
+    first unit stride 2 → 7x7 → pool → (R, 2048))."""
+
+    depth: int = 101
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        units = STAGE_UNITS[self.depth]
+        x = x.astype(self.dtype)
+        x = _stage(x, 2048, units[3], 2, self.dtype, "stage4")
+        # ref: bn1 + relu1 + global pool close the v2-style network
+        x = nn.relu(FrozenBatchNorm(dtype=self.dtype, name="bn1")(x))
+        return jnp.mean(x, axis=(1, 2))  # (R, 2048)

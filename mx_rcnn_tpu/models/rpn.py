@@ -1,0 +1,45 @@
+"""Region Proposal Network head.
+
+Reference: the RPN block repeated in ``rcnn/symbol/symbol_vgg.py`` /
+``symbol_resnet.py``: ``rpn_conv_3x3`` (512ch) + relu → ``rpn_cls_score``
+(1x1, 2A channels) and ``rpn_bbox_pred`` (1x1, 4A channels), initialized
+Normal(0.01).
+
+Output layout: NHWC with the per-cell anchors innermost — reshaped to
+``(H*W*A, 2)`` scores and ``(H*W*A, 4)`` deltas, matching the framework's
+HWA anchor enumeration (see ``ops/anchors.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.models.layers import conv
+
+Dtype = Any
+
+
+class RPNHead(nn.Module):
+    num_anchors: int = 9
+    mid_channels: int = 512
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, feat: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """feat (N, H, W, C) → (cls_logits (N, H*W*A, 2), deltas (N, H*W*A, 4))."""
+        init = nn.initializers.normal(0.01)
+        x = nn.relu(
+            conv(self.mid_channels, (3, 3), dtype=self.dtype,
+                 kernel_init=init, name="rpn_conv_3x3")(feat)
+        )
+        cls = conv(2 * self.num_anchors, (1, 1), dtype=self.dtype,
+                   kernel_init=init, name="rpn_cls_score")(x)
+        box = conv(4 * self.num_anchors, (1, 1), dtype=self.dtype,
+                   kernel_init=init, name="rpn_bbox_pred")(x)
+        n, h, w, _ = cls.shape
+        cls = cls.reshape(n, h * w * self.num_anchors, 2)
+        box = box.reshape(n, h * w * self.num_anchors, 4)
+        return cls, box

@@ -1,0 +1,46 @@
+"""A miniature backbone/head pair for tests and CI.
+
+No reference equivalent — the reference "tests" by training VGG16 for hours
+(SURVEY.md §4).  This framework's test pyramid instead exercises the full
+end-to-end train step (targets → proposal → ROIAlign → losses → SGD) in
+seconds on CPU with a 2-conv stride-16 backbone.  Everything outside the
+backbone/head (the entire detection machinery) is identical to the real
+networks, so pipeline bugs cannot hide behind model size.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.models.layers import conv
+
+Dtype = Any
+
+
+class TinyBackbone(nn.Module):
+    """Two strided convs → stride 16, 32 channels."""
+
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        x = nn.relu(conv(16, (5, 5), (4, 4), dtype=self.dtype, name="conv1")(x))
+        x = nn.relu(conv(32, (3, 3), (4, 4), dtype=self.dtype, name="conv2")(x))
+        return x
+
+
+class TinyHead(nn.Module):
+    """Flatten → 64-unit dense."""
+
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        r = x.shape[0]
+        x = x.astype(self.dtype).reshape(r, -1)
+        return nn.relu(nn.Dense(64, dtype=self.dtype, param_dtype=jnp.float32,
+                                name="fc")(x))

@@ -1,0 +1,66 @@
+"""VGG16 backbone and fc6/fc7 head.
+
+Reference: ``rcnn/symbol/symbol_vgg.py`` — ``get_vgg_conv`` (conv1_1 …
+relu5_3, four 2x2 max-pools → stride 16, no pool5) and the fc6/fc7 (4096)
+head applied to the flattened 7x7x512 ROI features in
+``get_vgg_train/test``.  Dropout (0.5) follows fc6/fc7 at train time as in
+the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.models.layers import conv
+
+Dtype = Any
+
+# (block name, num convs, filters); pool after blocks 1-4 only (stride 16)
+_VGG16_BLOCKS = (
+    ("conv1", 2, 64),
+    ("conv2", 2, 128),
+    ("conv3", 3, 256),
+    ("conv4", 3, 512),
+    ("conv5", 3, 512),
+)
+
+
+class VGGBackbone(nn.Module):
+    """Shared conv1–conv5 features, stride 16 (ref ``get_vgg_conv``)."""
+
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        for i, (name, n_convs, filters) in enumerate(_VGG16_BLOCKS):
+            for j in range(n_convs):
+                x = nn.relu(
+                    conv(filters, (3, 3), dtype=self.dtype, name=f"{name}_{j + 1}")(x)
+                )
+            if i < 4:  # no pool5 — conv5_3 stays at stride 16
+                x = nn.max_pool(x, (2, 2), (2, 2))
+        return x  # (N, H/16, W/16, 512)
+
+
+class VGGHead(nn.Module):
+    """Per-ROI fc6/fc7 head (ref get_vgg_train: flatten → fc6 4096 → relu →
+    dropout .5 → fc7 4096 → relu → dropout .5)."""
+
+    dtype: Dtype = jnp.float32
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        r = x.shape[0]
+        x = x.astype(self.dtype).reshape(r, -1)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, param_dtype=jnp.float32,
+                             name="fc6")(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, param_dtype=jnp.float32,
+                             name="fc7")(x))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return x  # (R, 4096)

@@ -1,0 +1,19 @@
+"""Distributed execution: the TPU-native replacement for MXNet KVStore.
+
+Reference (SURVEY.md §5.8): gradients were pushed per-array to
+``kvstore='device'`` (in-process multi-GPU allreduce) or ``'dist_sync'``
+(ps-lite parameter server) after backward, pulled before update.
+
+Here data parallelism is SPMD over a ``jax.sharding.Mesh``: the batch is
+sharded over the ``'data'`` axis, parameters are replicated, and the
+gradient all-reduce is a ``lax.pmean`` over ICI fused *inside* the compiled
+step — there is no host-driven sync phase at all.  Multi-host scaling uses
+the same program over a larger mesh (DCN axis between slices).
+"""
+
+from mx_rcnn_tpu.parallel.dp import (  # noqa: F401
+    device_mesh,
+    make_dp_train_step,
+    shard_batch,
+    replicate,
+)

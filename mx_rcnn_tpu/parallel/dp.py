@@ -1,0 +1,78 @@
+"""Data-parallel training over a device mesh.
+
+Replaces MXNet ``kvstore='device'`` (ref ``train_end2end.py`` passes the
+ctx list + kvstore into ``MutableModule.fit``; MXNet pushes/pulls each
+gradient array through the KVStore).  Here the whole step — forward,
+backward, ``lax.pmean`` gradient sync over ICI, SGD update — is one XLA
+program per device, built with ``jax.shard_map`` over a 1-D ``'data'`` mesh:
+
+* batch leaves are sharded on their leading (image) axis,
+* params / optimizer state are replicated (every device applies the same
+  psum-averaged update, so replicas stay bit-identical),
+* per-image RNG is decorrelated across shards by folding in the device's
+  mesh position.
+
+``BATCH_IMAGES`` keeps the reference's per-device meaning (SURVEY.md §2:
+"BATCH_IMAGES is per GPU"): a global batch of ``n_devices × batch_images``
+feeds the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.core.train import Batch, TrainState, make_train_step
+from mx_rcnn_tpu.models.faster_rcnn import FasterRCNN
+
+
+def device_mesh(n_devices: Optional[int] = None,
+                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D data-parallel mesh over the first ``n_devices`` devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), ("data",))
+
+
+def replicate(tree, mesh: Mesh):
+    """Place a pytree fully-replicated on the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)
+
+
+def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
+    """Shard every batch leaf along its leading (image) axis."""
+    sharding = NamedSharding(mesh, P("data"))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def make_dp_train_step(model: FasterRCNN, cfg: Config, tx, mesh: Mesh):
+    """Jitted SPMD train step over ``mesh``.
+
+    Takes (replicated state, sharded batch, replicated key); returns
+    (replicated state, replicated metrics).  Gradient sync is the
+    ``lax.pmean('data')`` inside ``core.train.make_train_step``.
+    """
+    base = make_train_step(model, cfg, tx, axis_name="data")
+
+    def shard_fn(state: TrainState, batch: Batch, key: jax.Array):
+        # decorrelate per-image sampling RNG across mesh positions
+        key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+        return base(state, batch, key)
+
+    sharded = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), P("data"), P()),
+        out_specs=(P(), P()),
+        check_vma=False,  # RNG fold_in of axis_index is deliberately varying
+    )
+    # donate the replicated state: in-place HBM update, no per-step copy
+    return jax.jit(sharded, donate_argnums=(0,))

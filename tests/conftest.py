@@ -9,12 +9,21 @@ Must set the env vars before the first ``import jax`` anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Overwrite, not setdefault: the machine env pins JAX_PLATFORMS=axon (the
+# single real TPU chip) via a sitecustomize hook that caches the platform at
+# interpreter startup, so the env var alone is ignored — the jax.config
+# update below is what actually forces CPU.  Unit tests must run on the
+# virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

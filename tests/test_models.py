@@ -1,0 +1,84 @@
+"""Model zoo tests: shapes, strides, dtype policy, full test-mode forward."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.models.resnet import ResNetBackbone
+from mx_rcnn_tpu.models.rpn import RPNHead
+from mx_rcnn_tpu.models.vgg import VGGBackbone
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_vgg_backbone_stride16():
+    m = VGGBackbone()
+    x = jnp.zeros((1, 64, 96, 3))
+    v = m.init(KEY, x)
+    y = m.apply(v, x)
+    assert y.shape == (1, 4, 6, 512)
+    # VGG has no BN — no batch_stats collection
+    assert "batch_stats" not in v
+
+
+def test_resnet_backbone_stride16_and_width():
+    m = ResNetBackbone(depth=50)
+    x = jnp.zeros((1, 64, 64, 3))
+    v = m.init(KEY, x)
+    y = m.apply(v, x)
+    assert y.shape == (1, 4, 4, 1024)
+    assert "batch_stats" in v  # frozen BN stats present
+
+
+def test_resnet101_param_structure():
+    m = ResNetBackbone(depth=101)
+    v = m.init(KEY, jnp.zeros((1, 32, 32, 3)))
+    names = set(v["params"].keys())
+    assert "conv0" in names and "stage3_unit23" in names  # 23 units in stage3
+    assert "stage3_unit24" not in names
+
+
+def test_rpn_head_layout():
+    m = RPNHead(num_anchors=9)
+    feat = jnp.zeros((2, 5, 7, 64))
+    v = m.init(KEY, feat)
+    cls, box = m.apply(v, feat)
+    assert cls.shape == (2, 5 * 7 * 9, 2)
+    assert box.shape == (2, 5 * 7 * 9, 4)
+
+
+def test_bf16_dtype_policy():
+    m = ResNetBackbone(depth=50, dtype=jnp.bfloat16)
+    x = jnp.zeros((1, 32, 32, 3))
+    v = m.init(KEY, x)
+    y = m.apply(v, x)
+    assert y.dtype == jnp.bfloat16
+    # params stay fp32
+    leaves = jax.tree.leaves(v["params"])
+    assert all(l.dtype == jnp.float32 for l in leaves)
+
+
+def test_full_model_test_forward_tiny():
+    cfg = generate_config("tiny", "PascalVOC")
+    model = build_model(cfg)
+    images = jnp.zeros((2, 128, 128, 3))
+    im_info = jnp.tile(jnp.array([[128.0, 128.0, 1.0]]), (2, 1))
+    variables = model.init(KEY, images, im_info)
+    rois, roi_valid, cls_prob, deltas = model.apply(variables, images, im_info)
+    r = cfg.test.rpn_post_nms_top_n
+    assert rois.shape == (2, r, 4)
+    assert roi_valid.shape == (2, r)
+    assert cls_prob.shape == (2, r, 21)
+    assert deltas.shape == (2, r, 84)
+    np.testing.assert_allclose(np.asarray(cls_prob.sum(-1)), 1.0, rtol=1e-4)
+
+
+def test_unknown_network_raises():
+    cfg = generate_config("tiny", "PascalVOC")
+    from mx_rcnn_tpu.models.faster_rcnn import FasterRCNN
+    bad = FasterRCNN(network="alexnet")
+    with pytest.raises(ValueError, match="unknown network"):
+        bad.init(KEY, jnp.zeros((1, 64, 64, 3)), jnp.zeros((1, 3)))

@@ -1,0 +1,82 @@
+"""Data-parallel tests on the 8-virtual-device CPU mesh (conftest.py).
+
+This is the framework's "multi-node without a cluster" strategy
+(SURVEY.md §4): collective code paths run on a real 8-device mesh.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.core.optim import make_optimizer
+from mx_rcnn_tpu.core.train import Batch, init_state, make_train_step
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.parallel import device_mesh, make_dp_train_step, replicate, shard_batch
+from tests.test_train_step import make_batch, tiny_setup
+
+KEY = jax.random.PRNGKey(7)
+
+
+def test_eight_virtual_devices_present():
+    assert jax.device_count() == 8
+
+
+def stack_batches(n, size=128):
+    bs = [make_batch(1, size, seed=s) for s in range(n)]
+    return Batch(*[jnp.concatenate([getattr(b, f) for b in bs]) for f in Batch._fields])
+
+
+def test_dp_step_runs_and_replicas_agree():
+    cfg, model, tx, state = tiny_setup()
+    mesh = device_mesh(8)
+    step = make_dp_train_step(model, cfg, tx, mesh)
+    state_r = replicate(state, mesh)
+    batch = shard_batch(stack_batches(8), mesh)
+    new_state, metrics = step(state_r, batch, KEY)
+    assert int(new_state.step) == 1
+    for k, v in metrics.items():
+        assert np.isfinite(float(v)), k
+    # updated params are a single replicated array — fetching works and is finite
+    leaf = new_state.params["backbone"]["conv1"]["kernel"]
+    assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_dp_grad_sync_matches_single_device_global_batch():
+    """DP over 8 shards must equal a single-device step on the global batch
+    when sampling randomness is aligned: here we verify the *deterministic*
+    part by overfitting both for several steps and comparing the loss scale
+    (exact bitwise equality is not expected because per-shard RNG folding
+    intentionally differs from single-device per-image splits)."""
+    cfg, model, tx, state = tiny_setup()
+    mesh = device_mesh(8)
+    dp_step = make_dp_train_step(model, cfg, tx, mesh)
+    single_step = jax.jit(make_train_step(model, cfg, tx))
+
+    global_batch = stack_batches(8)
+    # the DP step donates its state; replicate() may alias the source
+    # buffers, so give it an independent copy to keep `state` usable
+    s_dp = replicate(jax.tree.map(jnp.copy, state), mesh)
+    b_dp = shard_batch(global_batch, mesh)
+    s_sd = state
+    for i in range(3):
+        s_dp, m_dp = dp_step(s_dp, b_dp, KEY)
+        s_sd, m_sd = single_step(s_sd, global_batch, KEY)
+    # same data, same lr → losses must track closely
+    assert abs(float(m_dp["loss"]) - float(m_sd["loss"])) < 0.35 * float(m_sd["loss"]) + 0.1
+    # parameter trajectories stay within a loose envelope
+    d = jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))), s_dp.params, s_sd.params)
+    assert max(jax.tree.leaves(d)) < 0.15
+
+
+def test_dp_uneven_rng_decorrelated():
+    """Different shards must sample different ROIs — metrics must not be the
+    trivial value they'd have if every shard saw identical RNG *and* data."""
+    cfg, model, tx, state = tiny_setup()
+    mesh = device_mesh(8)
+    step = make_dp_train_step(model, cfg, tx, mesh)
+    # identical images on all shards, but per-shard RNG folding differs
+    b = make_batch(1, 128, seed=0)
+    batch = Batch(*[jnp.concatenate([getattr(b, f)] * 8) for f in Batch._fields])
+    _, metrics = step(replicate(state, mesh), shard_batch(batch, mesh), KEY)
+    assert np.isfinite(float(metrics["loss"]))
