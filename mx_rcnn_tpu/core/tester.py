@@ -1,0 +1,174 @@
+"""Prediction + detection evaluation.
+
+Reference: ``rcnn/core/tester.py`` — ``Predictor`` (binds the test symbol
+once per input shape), ``im_detect`` (forward + bbox decode + clip),
+``pred_eval`` (per-class threshold → NMS → cap max_per_image →
+``imdb.evaluate_detections``) and ``generate_proposals`` (RPN-only dump for
+alternate training).
+
+Normalization invariant (SURVEY.md §5.4): the reference trains bbox_pred
+against mean/std-normalized targets and **un-normalizes the weights at
+checkpoint time** (``do_checkpoint``), so saved models emit raw deltas.
+Here weights always stay in normalized space and the predictor applies
+``delta * std + mean`` at decode time — one convention everywhere, no
+weight rewriting; checkpoints are therefore directly resumable.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.models.faster_rcnn import FasterRCNN
+from mx_rcnn_tpu.ops.boxes import bbox_pred, clip_boxes
+from mx_rcnn_tpu.ops.nms import nms_mask
+
+
+class Predictor:
+    """Jit-compiled test-mode forward, cached per input shape
+    (the XLA analog of MutableModule's rebinding-on-shape-change)."""
+
+    def __init__(self, model: FasterRCNN, variables, cfg: Config):
+        self.model = model
+        self.variables = variables
+        self.cfg = cfg
+        self._fns: Dict[Tuple[int, ...], callable] = {}
+
+    def __call__(self, images: np.ndarray, im_info: np.ndarray):
+        shape = tuple(images.shape)
+        if shape not in self._fns:
+            model = self.model
+
+            @jax.jit
+            def fn(variables, images, im_info):
+                return model.apply(variables, images, im_info)
+
+            self._fns[shape] = fn
+        rois, roi_valid, cls_prob, deltas = self._fns[shape](
+            self.variables, jnp.asarray(images), jnp.asarray(im_info))
+        return (np.asarray(rois), np.asarray(roi_valid),
+                np.asarray(cls_prob), np.asarray(deltas))
+
+
+@functools.partial(jax.jit, static_argnames=("nms_thresh",))
+def _per_class_nms(boxes: jnp.ndarray, scores: jnp.ndarray, valid: jnp.ndarray,
+                   nms_thresh: float) -> jnp.ndarray:
+    return nms_mask(boxes, scores, nms_thresh, valid=valid)
+
+
+def im_detect_batch(
+    rois: np.ndarray,
+    roi_valid: np.ndarray,
+    cls_prob: np.ndarray,
+    deltas: np.ndarray,
+    im_info: np.ndarray,
+    scales: np.ndarray,
+    cfg: Config,
+) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Decode one forward batch into per-image (boxes_per_class, scores).
+
+    Applies the de-normalization invariant, decodes class-specific deltas,
+    clips to the image, and un-scales to raw image coordinates
+    (ref ``im_detect``).
+    Returns a list of (boxes (R, 4C), scores (R, C)) per image.
+    """
+    n, r, c4 = deltas.shape
+    num_classes = c4 // 4
+    stds = np.tile(np.asarray(cfg.train.bbox_stds, np.float32), num_classes)
+    means = np.tile(np.asarray(cfg.train.bbox_means, np.float32), num_classes)
+    out = []
+    for i in range(n):
+        d = deltas[i] * stds + means
+        boxes = np.asarray(bbox_pred(jnp.asarray(rois[i]), jnp.asarray(d)))
+        boxes = np.asarray(clip_boxes(jnp.asarray(boxes),
+                                      (im_info[i, 0], im_info[i, 1])))
+        boxes = boxes / scales[i]  # back to raw image coordinates
+        scores = cls_prob[i] * roi_valid[i][:, None]  # padded slots → 0
+        out.append((boxes, scores))
+    return out
+
+
+def pred_eval(predictor: Predictor, test_loader, imdb, cfg: Config,
+              out_dir: str = None, verbose: bool = True) -> Dict[str, float]:
+    """Full evaluation loop (ref ``pred_eval``): forward every image,
+    per-class score threshold + NMS, cap ``max_per_image``, then
+    ``imdb.evaluate_detections``."""
+    num_classes = imdb.num_classes
+    num_images = len(test_loader.roidb)
+    all_boxes: List[List[np.ndarray]] = [
+        [np.zeros((0, 5), np.float32) for _ in range(num_images)]
+        for _ in range(num_classes)
+    ]
+    thresh = cfg.test.score_thresh
+    done = 0
+    for batch, indices, scales in test_loader:
+        rois, roi_valid, cls_prob, deltas = predictor(batch.images,
+                                                      batch.im_info)
+        decoded = im_detect_batch(rois, roi_valid, cls_prob, deltas,
+                                  batch.im_info, scales, cfg)
+        for j, i in enumerate(indices):
+            boxes, scores = decoded[j]
+            kept_all = []
+            for c in range(1, num_classes):
+                inds = scores[:, c] > thresh
+                if not inds.any():
+                    continue
+                cls_boxes = boxes[inds, 4 * c:4 * c + 4]
+                cls_scores = scores[inds, c]
+                keep = np.asarray(_per_class_nms(
+                    jnp.asarray(cls_boxes), jnp.asarray(cls_scores),
+                    jnp.ones(len(cls_scores), bool), cfg.test.nms))
+                dets = np.hstack([cls_boxes[keep],
+                                  cls_scores[keep, None]]).astype(np.float32)
+                all_boxes[c][i] = dets
+                kept_all.append(dets[:, 4])
+            # cap detections per image by score (ref max_per_image=100)
+            if kept_all:
+                all_scores = np.concatenate(kept_all)
+                if len(all_scores) > cfg.test.max_per_image:
+                    score_thresh = np.sort(all_scores)[
+                        -cfg.test.max_per_image]
+                    for c in range(1, num_classes):
+                        keep = all_boxes[c][i][:, 4] >= score_thresh
+                        all_boxes[c][i] = all_boxes[c][i][keep]
+        done += len(indices)
+        if verbose:
+            print(f"eval: {done}/{num_images} images")
+    results = imdb.evaluate_detections(all_boxes, out_dir) if out_dir \
+        else imdb.evaluate_detections(all_boxes)
+    return results
+
+
+def generate_proposals(model: FasterRCNN, variables, test_loader, cfg: Config
+                       ) -> List[np.ndarray]:
+    """RPN-only proposal dump for alternate training
+    (ref ``generate_proposals`` writes rpn_data/*.pkl; here the (R, 5)
+    [x1 y1 x2 y2 score] arrays are returned in roidb order and the caller
+    persists them)."""
+    num_images = len(test_loader.roidb)
+    proposals: List[np.ndarray] = [None] * num_images
+    fns: Dict[Tuple[int, ...], callable] = {}
+    pre = cfg.test.proposal_pre_nms_top_n
+    post = cfg.test.proposal_post_nms_top_n
+    for batch, indices, scales in test_loader:
+        shape = tuple(batch.images.shape)
+        if shape not in fns:
+            @jax.jit
+            def fn(variables, images, im_info):
+                return model.apply(variables, images, im_info, pre, post,
+                                   method=model.rpn_proposals)
+
+            fns[shape] = fn
+        rois, scores, roi_valid = map(np.asarray, fns[shape](
+            variables, jnp.asarray(batch.images), jnp.asarray(batch.im_info)))
+        for j, i in enumerate(indices):
+            valid = roi_valid[j]
+            boxes = rois[j][valid] / scales[j]
+            proposals[i] = np.hstack(
+                [boxes, scores[j][valid][:, None]]).astype(np.float32)
+    return proposals

@@ -107,6 +107,29 @@ class FasterRCNN(nn.Module):
             )
         )
 
+    def rpn_proposals(self, images: jnp.ndarray, im_info: jnp.ndarray,
+                      pre_nms_top_n: int = 6000, post_nms_top_n: int = 300
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """RPN-only forward (ref ``get_*_rpn_test`` symbol): images →
+        (rois, fg scores, valid) — used by generate_proposals in alternate
+        training and by test_rpn."""
+        feat = self.features(images)
+        rpn_cls, rpn_box = self.rpn_raw(feat)
+        _, fh, fw, _ = feat.shape
+        anchors = self.anchors_for(fh, fw)
+        fg = jax.nn.softmax(rpn_cls.astype(jnp.float32), axis=-1)[..., 1]
+
+        def one(scores_i, box_i, info_i):
+            return propose(
+                scores_i, box_i, anchors, info_i,
+                pre_nms_top_n=pre_nms_top_n,
+                post_nms_top_n=post_nms_top_n,
+                nms_thresh=self.test_nms_thresh,
+                min_size=self.test_min_size,
+            )
+
+        return jax.vmap(one)(fg, rpn_box.astype(jnp.float32), im_info)
+
     # ---- full test-mode forward (ref get_*_test symbol) -------------------
 
     def __call__(self, images: jnp.ndarray, im_info: jnp.ndarray

@@ -1,0 +1,152 @@
+"""Data layer tests: image transforms, roidb, VOC parsing/eval, loaders."""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data.image import choose_bucket, resize_keep_ratio
+from mx_rcnn_tpu.data.loader import AnchorLoader, TestLoader
+from mx_rcnn_tpu.data.pascal_voc import PascalVOC
+from mx_rcnn_tpu.data.roidb import IMDB, filter_roidb, merge_roidbs
+from mx_rcnn_tpu.data.synthetic import SyntheticDataset
+from mx_rcnn_tpu.data.voc_eval import voc_ap, voc_eval
+
+
+def test_resize_keep_ratio_short_side():
+    img = np.zeros((480, 640, 3), np.uint8)
+    out, scale = resize_keep_ratio(img, 600, 1000)
+    assert min(out.shape[:2]) == 600
+    assert abs(scale - 600 / 480) < 1e-6
+
+
+def test_resize_keep_ratio_long_side_cap():
+    img = np.zeros((300, 900, 3), np.uint8)
+    out, scale = resize_keep_ratio(img, 600, 1000)
+    assert max(out.shape[:2]) <= 1000
+    assert abs(scale - 1000 / 900) < 1e-6
+
+
+def test_choose_bucket_orientation():
+    buckets = ((608, 1024), (1024, 608))
+    assert choose_bucket(600, 1000, buckets) == (608, 1024)
+    assert choose_bucket(1000, 600, buckets) == (1024, 608)
+
+
+def test_append_flipped_images():
+    roidb = [dict(image="x.jpg", height=100, width=200,
+                  boxes=np.array([[10.0, 20.0, 50.0, 60.0]], np.float32),
+                  gt_classes=np.array([3], np.int32), flipped=False)]
+    out = IMDB.append_flipped_images(roidb)
+    assert len(out) == 2
+    assert out[1]["flipped"] is True
+    np.testing.assert_allclose(out[1]["boxes"], [[149.0, 20.0, 189.0, 60.0]])
+
+
+def test_merge_and_filter_roidb():
+    a = [dict(boxes=np.zeros((1, 4)))]
+    b = [dict(boxes=np.zeros((0, 4))), dict(boxes=np.zeros((2, 4)))]
+    merged = merge_roidbs([a, b])
+    assert len(merged) == 3
+    assert len(filter_roidb(merged)) == 2
+
+
+def test_voc_ap_known_curve():
+    rec = np.array([0.0, 0.5, 1.0])
+    prec = np.array([1.0, 1.0, 1.0])
+    assert abs(voc_ap(rec, prec, use_07_metric=True) - 1.0) < 1e-6
+    assert abs(voc_ap(rec, prec, use_07_metric=False) - 1.0) < 1e-6
+
+
+def test_voc_eval_perfect_and_miss():
+    gt = {"img1": dict(boxes=np.array([[0.0, 0.0, 10.0, 10.0]]),
+                       gt_classes=np.array([1]),
+                       difficult=np.zeros(1, bool))}
+    perfect = {"img1": np.array([[0.0, 0.0, 10.0, 10.0, 0.9]])}
+    assert voc_eval(perfect, gt, 1) > 0.99
+    miss = {"img1": np.array([[50.0, 50.0, 60.0, 60.0, 0.9]])}
+    assert voc_eval(miss, gt, 1) == 0.0
+
+
+def _write_fake_voc(root):
+    voc = os.path.join(root, "VOCdevkit", "VOC2007")
+    os.makedirs(os.path.join(voc, "ImageSets", "Main"), exist_ok=True)
+    os.makedirs(os.path.join(voc, "Annotations"), exist_ok=True)
+    os.makedirs(os.path.join(voc, "JPEGImages"), exist_ok=True)
+    with open(os.path.join(voc, "ImageSets", "Main", "train.txt"), "w") as f:
+        f.write("000001\n")
+    xml = textwrap.dedent("""\
+        <annotation>
+          <size><width>353</width><height>500</height><depth>3</depth></size>
+          <object><name>dog</name><difficult>0</difficult>
+            <bndbox><xmin>48</xmin><ymin>240</ymin><xmax>195</xmax><ymax>371</ymax></bndbox>
+          </object>
+          <object><name>person</name><difficult>1</difficult>
+            <bndbox><xmin>8</xmin><ymin>12</ymin><xmax>352</xmax><ymax>498</ymax></bndbox>
+          </object>
+        </annotation>""")
+    with open(os.path.join(voc, "Annotations", "000001.xml"), "w") as f:
+        f.write(xml)
+    return os.path.join(root, "VOCdevkit")
+
+
+def test_pascal_voc_parsing(tmp_path):
+    devkit = _write_fake_voc(str(tmp_path))
+    ds = PascalVOC("2007_train", str(tmp_path), devkit)
+    roidb = ds._load_annotations()
+    assert len(roidb) == 1
+    rec = roidb[0]
+    assert rec["width"] == 353 and rec["height"] == 500
+    # difficult object excluded by default; dog = class 12 in VOC order
+    assert len(rec["boxes"]) == 1
+    assert rec["gt_classes"][0] == ds.classes.index("dog")
+    np.testing.assert_allclose(rec["boxes"][0], [47.0, 239.0, 194.0, 370.0])
+
+
+def test_synthetic_dataset_and_loaders(tmp_path):
+    cfg = generate_config("tiny", "PascalVOC")
+    cfg = cfg.replace_in("bucket", shapes=((128, 160), (160, 128)),
+                         scale=120, max_size=160)
+    cfg = cfg.replace_in("train", max_gt_boxes=8)
+    ds = SyntheticDataset("train", str(tmp_path), "", num_images=6,
+                          image_size=(96, 128))
+    roidb = ds.gt_roidb()
+    assert len(roidb) == 6
+    assert all(os.path.exists(r["image"]) for r in roidb)
+
+    loader = AnchorLoader(roidb, cfg, batch_images=2, shuffle=True)
+    batches = list(loader)
+    assert len(batches) == 3
+    b = batches[0]
+    assert b.images.shape[0] == 2
+    assert b.images.shape[1:] in ((128, 160, 3), (160, 128, 3))
+    assert b.gt_valid.any()
+    # gt boxes scaled into resized image extent
+    for j in range(2):
+        h, w = b.im_info[j, 0], b.im_info[j, 1]
+        valid_boxes = b.gt_boxes[j][b.gt_valid[j]]
+        assert (valid_boxes[:, 2] <= w - 1 + 1e-3).all()
+        assert (valid_boxes[:, 3] <= h - 1 + 1e-3).all()
+
+    tl = TestLoader(roidb, cfg, batch_images=2)
+    seen = []
+    for batch, indices, scales in tl:
+        seen.extend(indices)
+        assert batch.images.shape[0] == len(indices) == len(scales)
+    assert sorted(seen) == list(range(6))
+
+
+def test_synthetic_eval_selfconsistent(tmp_path):
+    """Feeding the ground truth as detections must give mAP ≈ 1."""
+    ds = SyntheticDataset("train", str(tmp_path), "", num_images=4,
+                          image_size=(96, 128), num_classes=5)
+    all_boxes = [[np.zeros((0, 5), np.float32) for _ in range(4)]
+                 for _ in range(ds.num_classes)]
+    for i, spec in enumerate(ds._specs):
+        for box, cls in zip(spec["boxes"], spec["gt_classes"]):
+            det = np.concatenate([box, [0.99]]).astype(np.float32)[None]
+            all_boxes[int(cls)][i] = np.vstack([all_boxes[int(cls)][i], det])
+    res = ds.evaluate_detections(all_boxes)
+    assert res["mAP"] > 0.95, res
