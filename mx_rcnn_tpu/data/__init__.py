@@ -1,0 +1,36 @@
+"""Data pipeline: datasets (roidb), image IO, static-shape batch loading.
+
+Reference: layer L4 of SURVEY.md — ``rcnn/dataset/`` (IMDB/roidb, VOC, COCO),
+``rcnn/io/`` (image transforms, batch assembly) and ``rcnn/core/loader.py``
+(AnchorLoader / ROIIter / TestLoader DataIters).
+
+TPU-native differences:
+* images are padded into a small set of static shape buckets instead of the
+  reference's per-batch max-shape padding + executor rebinding,
+* RPN/RCNN target assignment moved on-device (``ops/targets.py``), so the
+  loader only produces (images, im_info, gt) batches — the host does image
+  decode + resize only (critical: this machine class has few host cores),
+* a synthetic dataset provides download-free training/eval for tests, demos
+  and benchmarks.
+"""
+
+from mx_rcnn_tpu.data.image import load_and_transform, resize_to_bucket  # noqa: F401
+from mx_rcnn_tpu.data.loader import AnchorLoader, TestLoader  # noqa: F401
+from mx_rcnn_tpu.data.roidb import IMDB, filter_roidb, merge_roidbs  # noqa: F401
+from mx_rcnn_tpu.data.pascal_voc import PascalVOC  # noqa: F401
+from mx_rcnn_tpu.data.coco import COCODataset  # noqa: F401
+from mx_rcnn_tpu.data.synthetic import SyntheticDataset  # noqa: F401
+
+
+def get_dataset(name: str, image_set: str, root_path: str, dataset_path: str,
+                **kw):
+    """Dataset factory (ref ``rcnn/utils/load_data.py — load_gt_roidb``'s
+    eval-by-name)."""
+    table = {
+        "PascalVOC": PascalVOC,
+        "coco": COCODataset,
+        "synthetic": SyntheticDataset,
+    }
+    if name not in table:
+        raise KeyError(f"unknown dataset {name!r}; have {sorted(table)}")
+    return table[name](image_set, root_path, dataset_path, **kw)

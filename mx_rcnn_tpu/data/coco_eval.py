@@ -1,0 +1,200 @@
+"""COCO-style bbox mAP evaluation, dependency-free.
+
+Reference: the vendored ``rcnn/pycocotools/cocoeval.py — COCOeval`` (bbox
+mode).  pycocotools is not installable in this environment, so the bbox
+evaluation protocol is reimplemented here in NumPy: greedy score-ordered
+matching per (category, IoU threshold), crowd boxes as ignore regions,
+101-point interpolated precision averaged over IoU 0.50:0.95:0.05, plus the
+AP50/AP75 and small/medium/large area breakdowns.  RLE mask evaluation is
+NOT reimplemented (the reference only uses bbox eval for Faster R-CNN).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Sequence
+
+import numpy as np
+
+IOU_THRS = np.round(np.arange(0.5, 1.0, 0.05), 2)
+RECALL_THRS = np.linspace(0.0, 1.0, 101)
+AREA_RANGES = {
+    "all": (0.0, 1e10),
+    "small": (0.0, 32.0 ** 2),
+    "medium": (32.0 ** 2, 96.0 ** 2),
+    "large": (96.0 ** 2, 1e10),
+}
+
+
+def _iou_xyxy(dets: np.ndarray, gts: np.ndarray, iscrowd: np.ndarray
+              ) -> np.ndarray:
+    """IoU matrix (D, G); for crowd gt, IoU = intersection / det area
+    (pycocotools semantics)."""
+    d = dets[:, None, :]
+    g = gts[None, :, :]
+    iw = np.minimum(d[..., 2], g[..., 2]) - np.maximum(d[..., 0], g[..., 0])
+    ih = np.minimum(d[..., 3], g[..., 3]) - np.maximum(d[..., 1], g[..., 1])
+    iw = np.maximum(iw, 0.0)
+    ih = np.maximum(ih, 0.0)
+    inter = iw * ih
+    area_d = (dets[:, 2] - dets[:, 0]) * (dets[:, 3] - dets[:, 1])
+    area_g = (gts[:, 2] - gts[:, 0]) * (gts[:, 3] - gts[:, 1])
+    union = area_d[:, None] + area_g[None, :] - inter
+    union = np.where(iscrowd[None, :], area_d[:, None], union)
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+def _evaluate_image(dets: np.ndarray, gt_boxes: np.ndarray,
+                    gt_ignore: np.ndarray, iscrowd: np.ndarray,
+                    max_dets: int):
+    """Match one image's detections for all IoU thresholds at once.
+
+    Returns (det_scores (D,), det_matched (T, D), det_ignore (T, D),
+    num_gt_not_ignored).
+    """
+    order = np.argsort(-dets[:, 4], kind="mergesort")[:max_dets]
+    dets = dets[order]
+    nd = len(dets)
+    ngt = len(gt_boxes)
+    t = len(IOU_THRS)
+    matched = np.zeros((t, nd), bool)
+    ignored = np.zeros((t, nd), bool)
+    if ngt:
+        # sort gt: real first, ignored last (pycocotools order)
+        gt_order = np.argsort(gt_ignore, kind="mergesort")
+        gt_boxes = gt_boxes[gt_order]
+        gt_ignore_s = gt_ignore[gt_order]
+        crowd_s = iscrowd[gt_order]
+        ious = _iou_xyxy(dets[:, :4], gt_boxes, crowd_s)
+        for ti, thr in enumerate(IOU_THRS):
+            gt_used = np.zeros(ngt, bool)
+            for di in range(nd):
+                best_iou = min(thr, 1 - 1e-10)
+                best_g = -1
+                for gi in range(ngt):
+                    if gt_used[gi] and not crowd_s[gi]:
+                        continue
+                    # stop matching real gt once we reach ignored ones if a
+                    # real match was already found
+                    if best_g > -1 and not gt_ignore_s[best_g] and gt_ignore_s[gi]:
+                        break
+                    if ious[di, gi] < best_iou:
+                        continue
+                    best_iou = ious[di, gi]
+                    best_g = gi
+                if best_g >= 0:
+                    gt_used[best_g] = True
+                    matched[ti, di] = True
+                    ignored[ti, di] = gt_ignore_s[best_g]
+    return dets[:, 4], matched, ignored, int((~gt_ignore).sum())
+
+
+def evaluate_bbox(
+    dets_by_image_cat: Mapping[str, Mapping[int, np.ndarray]],
+    gt_by_image_cat: Mapping[str, Mapping[int, Dict]],
+    categories: Sequence[int],
+    max_dets: int = 100,
+) -> Dict[str, float]:
+    """COCO bbox AP.
+
+    Args:
+      dets_by_image_cat: image id → {category → (k, 5) [x1 y1 x2 y2 score]}.
+      gt_by_image_cat: image id → {category → dict(boxes (n, 4),
+        iscrowd (n,) bool, area (n,))}; area defaults to box area.
+      categories: category ids to evaluate.
+    Returns dict with AP, AP50, AP75, AP_small/medium/large, AR_100.
+    """
+    images = list(gt_by_image_cat.keys())
+    t = len(IOU_THRS)
+    precisions = {k: [] for k in AREA_RANGES}  # per (cat): (T, 101) arrays
+    recalls = {k: [] for k in AREA_RANGES}
+
+    for cat in categories:
+        per_area_stats = {k: [] for k in AREA_RANGES}
+        for area_name, (lo, hi) in AREA_RANGES.items():
+            scores_all, matched_all, ignored_all = [], [], []
+            npos = 0
+            for img in images:
+                gt = gt_by_image_cat[img].get(cat)
+                if gt is None:
+                    gt_boxes = np.zeros((0, 4))
+                    iscrowd = np.zeros((0,), bool)
+                    areas = np.zeros((0,))
+                else:
+                    gt_boxes = np.asarray(gt["boxes"]).reshape(-1, 4)
+                    iscrowd = np.asarray(
+                        gt.get("iscrowd", np.zeros(len(gt_boxes), bool)), bool)
+                    areas = np.asarray(gt.get(
+                        "area",
+                        (gt_boxes[:, 2] - gt_boxes[:, 0])
+                        * (gt_boxes[:, 3] - gt_boxes[:, 1])))
+                gt_ignore = iscrowd | (areas < lo) | (areas >= hi)
+                dets = dets_by_image_cat.get(img, {}).get(cat)
+                dets = (np.asarray(dets).reshape(-1, 5) if dets is not None
+                        else np.zeros((0, 5)))
+                if len(dets) == 0 and len(gt_boxes) == 0:
+                    continue
+                s, m, ig, np_img = _evaluate_image(
+                    dets, gt_boxes, gt_ignore, iscrowd, max_dets)
+                # detections outside the area range that match nothing are
+                # ignored too (pycocotools marks unmatched out-of-range dets)
+                d_area = (dets[:, 2] - dets[:, 0]) * (dets[:, 3] - dets[:, 1])
+                order = np.argsort(-dets[:, 4], kind="mergesort")[:max_dets]
+                oor = (d_area[order] < lo) | (d_area[order] >= hi)
+                ig = ig | (~m & oor[None, :])
+                scores_all.append(s)
+                matched_all.append(m)
+                ignored_all.append(ig)
+                npos += np_img
+            if npos == 0:
+                per_area_stats[area_name] = None
+                continue
+            scores = np.concatenate(scores_all) if scores_all else np.zeros(0)
+            matched = (np.concatenate(matched_all, axis=1) if matched_all
+                       else np.zeros((t, 0), bool))
+            ignored = (np.concatenate(ignored_all, axis=1) if ignored_all
+                       else np.zeros((t, 0), bool))
+            order = np.argsort(-scores, kind="mergesort")
+            matched = matched[:, order]
+            ignored = ignored[:, order]
+            prec_interp = np.zeros((t, len(RECALL_THRS)))
+            rec_final = np.zeros(t)
+            for ti in range(t):
+                keep = ~ignored[ti]
+                tps = np.cumsum(matched[ti][keep])
+                fps = np.cumsum(~matched[ti][keep])
+                rec = tps / npos
+                prec = tps / np.maximum(tps + fps, 1e-12)
+                # make precision monotonically decreasing then sample
+                for i in range(len(prec) - 1, 0, -1):
+                    prec[i - 1] = max(prec[i - 1], prec[i])
+                idx = np.searchsorted(rec, RECALL_THRS, side="left")
+                valid = idx < len(prec)
+                prec_interp[ti, valid] = prec[idx[valid]]
+                rec_final[ti] = rec[-1] if len(rec) else 0.0
+            per_area_stats[area_name] = (prec_interp, rec_final)
+        for area_name in AREA_RANGES:
+            st = per_area_stats[area_name]
+            if st is not None:
+                precisions[area_name].append(st[0])
+                recalls[area_name].append(st[1])
+
+    def mean_ap(area: str, thr_idx=None) -> float:
+        ps = precisions[area]
+        if not ps:
+            return float("nan")
+        arr = np.stack(ps)  # (cats, T, 101)
+        if thr_idx is not None:
+            arr = arr[:, thr_idx:thr_idx + 1]
+        return float(arr.mean())
+
+    out = {
+        "AP": mean_ap("all"),
+        "AP50": mean_ap("all", 0),
+        "AP75": mean_ap("all", 5),
+        "AP_small": mean_ap("small"),
+        "AP_medium": mean_ap("medium"),
+        "AP_large": mean_ap("large"),
+    }
+    if recalls["all"]:
+        out["AR_100"] = float(np.stack(recalls["all"]).mean())
+    return out

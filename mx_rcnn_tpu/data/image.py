@@ -1,0 +1,118 @@
+"""Image IO and geometric transforms.
+
+Reference: ``rcnn/io/image.py`` — ``get_image`` (cv2 imread + flip via
+roidb flag), ``resize`` (short side to SCALES[0]=600, long side capped at
+1000), ``transform`` (BGR→RGB, subtract PIXEL_MEANS, HWC→CHW) and
+``tensor_vstack`` (pad to per-batch max shape).
+
+TPU-native: layout stays NHWC (HWC per image); padding targets one of the
+static shape buckets from ``BucketConfig`` rather than the batch max, so
+every batch compiles to one of a handful of XLA programs.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+try:
+    import cv2
+
+    _HAS_CV2 = True
+except Exception:  # pragma: no cover - cv2 is present in the image
+    from PIL import Image
+
+    _HAS_CV2 = False
+
+
+def imread_rgb(path: str) -> np.ndarray:
+    """Read an image file as RGB uint8 (H, W, 3)."""
+    if _HAS_CV2:
+        img = cv2.imread(path, cv2.IMREAD_COLOR)
+        if img is None:
+            raise FileNotFoundError(f"cannot read image {path!r}")
+        return img[:, :, ::-1]  # BGR → RGB (ref transform does the same)
+    with Image.open(path) as im:  # pragma: no cover
+        return np.asarray(im.convert("RGB"))
+
+
+def resize_keep_ratio(img: np.ndarray, target_size: int, max_size: int
+                      ) -> Tuple[np.ndarray, float]:
+    """Scale so the short side is ``target_size`` without the long side
+    exceeding ``max_size`` (ref ``rcnn/io/image.py — resize``).
+
+    Returns (resized image, scale factor).
+    """
+    h, w = img.shape[:2]
+    short, long = min(h, w), max(h, w)
+    scale = float(target_size) / short
+    if round(scale * long) > max_size:
+        scale = float(max_size) / long
+    new_w, new_h = int(round(w * scale)), int(round(h * scale))
+    if _HAS_CV2:
+        out = cv2.resize(img, (new_w, new_h), interpolation=cv2.INTER_LINEAR)
+    else:  # pragma: no cover
+        out = np.asarray(Image.fromarray(img).resize((new_w, new_h)))
+    return out, scale
+
+
+def choose_bucket(h: int, w: int, buckets: Sequence[Tuple[int, int]]
+                  ) -> Tuple[int, int]:
+    """Pick the smallest bucket that fits (h, w); falls back to the bucket
+    with the matching orientation (ref ASPECT_GROUPING maps wide/tall images
+    to landscape/portrait groups)."""
+    fitting = [b for b in buckets if b[0] >= h and b[1] >= w]
+    if fitting:
+        return min(fitting, key=lambda b: b[0] * b[1])
+    # no bucket fits (shouldn't happen with ref scales 600/1000) — take the
+    # same-orientation bucket; caller will downscale to fit
+    landscape = w >= h
+    same = [b for b in buckets if (b[1] >= b[0]) == landscape]
+    return max(same or buckets, key=lambda b: b[0] * b[1])
+
+
+def load_and_transform(
+    path: str,
+    flipped: bool,
+    pixel_means: Sequence[float],
+    scale: int,
+    max_size: int,
+    bucket: Tuple[int, int],
+) -> Tuple[np.ndarray, float]:
+    """Full per-image host pipeline: read → flip → resize → mean-subtract →
+    pad into the bucket.  Returns ((bh, bw, 3) fp32 image, im_scale)."""
+    img = imread_rgb(path).astype(np.float32)
+    if flipped:
+        img = img[:, ::-1, :]
+    img, im_scale = resize_keep_ratio(img, scale, max_size)
+    h, w = img.shape[:2]
+    bh, bw = bucket
+    if h > bh or w > bw:  # bucket smaller than resize target: shrink to fit
+        fit = min(bh / h, bw / w)
+        new_w, new_h = int(w * fit), int(h * fit)
+        if _HAS_CV2:
+            img = cv2.resize(img, (new_w, new_h))
+        else:  # pragma: no cover
+            img = np.asarray(Image.fromarray(img.astype(np.uint8)).resize((new_w, new_h))).astype(np.float32)
+        im_scale *= fit
+        h, w = new_h, new_w
+    img -= np.asarray(pixel_means, dtype=np.float32)
+    out = np.zeros((bh, bw, 3), dtype=np.float32)
+    out[:h, :w] = img
+    return out, im_scale
+
+
+def resize_to_bucket(img: np.ndarray, pixel_means: Sequence[float], scale: int,
+                     max_size: int, buckets: Sequence[Tuple[int, int]]
+                     ) -> Tuple[np.ndarray, float, Tuple[int, int]]:
+    """In-memory variant of :func:`load_and_transform` (demo path)."""
+    img = img.astype(np.float32)
+    resized, im_scale = resize_keep_ratio(img, scale, max_size)
+    h, w = resized.shape[:2]
+    bucket = choose_bucket(h, w, buckets)
+    bh, bw = bucket
+    resized -= np.asarray(pixel_means, dtype=np.float32)
+    out = np.zeros((bh, bw, 3), dtype=np.float32)
+    out[:h, :w] = resized
+    return out, im_scale, bucket

@@ -1,0 +1,171 @@
+"""Batch loaders producing static-shape device batches.
+
+Reference: ``rcnn/core/loader.py`` — ``AnchorLoader`` (shuffle with
+aspect-ratio grouping, image load/augment, host-side ``assign_anchor``,
+pad-to-batch-max) and ``TestLoader``.
+
+TPU-native differences:
+* anchor/proposal target assignment happens ON DEVICE inside the jitted
+  train step, so ``AnchorLoader`` here only assembles (images, im_info, gt)
+  — with a single host core feeding up to 8 chips, host-side assignment
+  would dominate the step time,
+* images pad into static buckets; aspect grouping (ref ASPECT_GROUPING)
+  becomes bucket grouping: every batch holds images of one bucket so a
+  fixed set of XLA programs serves the whole epoch,
+* gt arrays are padded to ``max_gt_boxes`` with a validity mask instead of
+  variable-length label blobs.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.core.train import Batch
+from mx_rcnn_tpu.data.image import choose_bucket, load_and_transform
+from mx_rcnn_tpu.data.roidb import Roidb
+
+
+def _bucket_of(rec, buckets, scale, max_size) -> Tuple[int, int]:
+    """Bucket for a roidb record after reference resizing."""
+    h, w = rec["height"], rec["width"]
+    short, long = min(h, w), max(h, w)
+    s = scale / short
+    if round(s * long) > max_size:
+        s = max_size / long
+    return choose_bucket(int(round(h * s)), int(round(w * s)), buckets)
+
+
+class AnchorLoader:
+    """Training loader (name kept for reference parity).
+
+    Iterating yields ``Batch`` namedtuples of static shape; all images in a
+    batch share one bucket.  One pass = one epoch (ref DataIter.reset
+    semantics are replaced by re-iterating).
+    """
+
+    def __init__(self, roidb: Roidb, cfg: Config, batch_images: int = None,
+                 shuffle: bool = True, seed: int = 0):
+        self.roidb = list(roidb)
+        self.cfg = cfg
+        self.batch_images = batch_images or cfg.train.batch_images
+        self.shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+        b = cfg.bucket
+        self.buckets = tuple(tuple(s) for s in b.shapes)
+        self._bucket_ids = [
+            _bucket_of(rec, self.buckets, b.scale, b.max_size)
+            for rec in self.roidb
+        ]
+
+    def __len__(self) -> int:
+        return sum(
+            len(self._indices_for(bucket)) // self.batch_images
+            for bucket in set(self._bucket_ids)
+        )
+
+    def _indices_for(self, bucket) -> List[int]:
+        return [i for i, b in enumerate(self._bucket_ids) if b == bucket]
+
+    def _make_batch(self, indices: Sequence[int], bucket) -> Batch:
+        cfg = self.cfg
+        g = cfg.train.max_gt_boxes
+        n = len(indices)
+        bh, bw = bucket
+        images = np.zeros((n, bh, bw, 3), np.float32)
+        im_info = np.zeros((n, 3), np.float32)
+        gt_boxes = np.zeros((n, g, 4), np.float32)
+        gt_classes = np.zeros((n, g), np.int32)
+        gt_valid = np.zeros((n, g), bool)
+        for j, i in enumerate(indices):
+            rec = self.roidb[i]
+            img, im_scale = load_and_transform(
+                rec["image"], rec.get("flipped", False),
+                cfg.network.pixel_means, cfg.bucket.scale,
+                cfg.bucket.max_size, bucket)
+            images[j] = img
+            im_info[j] = (round(rec["height"] * im_scale),
+                          round(rec["width"] * im_scale), im_scale)
+            k = min(len(rec["boxes"]), g)
+            if k:
+                gt_boxes[j, :k] = rec["boxes"][:k] * im_scale
+                gt_classes[j, :k] = rec["gt_classes"][:k]
+                gt_valid[j, :k] = True
+        return Batch(images, im_info, gt_boxes, gt_classes, gt_valid)
+
+    def __iter__(self) -> Iterator[Batch]:
+        order_by_bucket = {}
+        for bucket in set(self._bucket_ids):
+            idx = self._indices_for(bucket)
+            if self.shuffle:
+                self._rng.shuffle(idx)
+            order_by_bucket[bucket] = idx
+        # interleave buckets batch-by-batch (ref shuffles group pairs)
+        batches = []
+        for bucket, idx in order_by_bucket.items():
+            for s in range(0, len(idx) - self.batch_images + 1,
+                           self.batch_images):
+                batches.append((bucket, idx[s:s + self.batch_images]))
+        if self.shuffle:
+            self._rng.shuffle(batches)
+        for bucket, indices in batches:
+            yield self._make_batch(indices, bucket)
+
+
+class TestLoader:
+    """Evaluation loader (ref ``TestLoader``): yields
+    ``(Batch, indices, scales)`` — gt fields are zero-filled, ``indices``
+    are roidb positions and ``scales`` un-map detections back to raw image
+    coordinates (ref pred_eval divides boxes by im_scale)."""
+
+    def __init__(self, roidb: Roidb, cfg: Config, batch_images: int = None):
+        self.roidb = list(roidb)
+        self.cfg = cfg
+        self.batch_images = batch_images or cfg.test.batch_images
+        b = cfg.bucket
+        self.buckets = tuple(tuple(s) for s in b.shapes)
+        self._bucket_ids = [
+            _bucket_of(rec, self.buckets, b.scale, b.max_size)
+            for rec in self.roidb
+        ]
+
+    def __len__(self) -> int:
+        import math
+
+        return sum(
+            math.ceil(
+                len([i for i, b in enumerate(self._bucket_ids) if b == bucket])
+                / self.batch_images)
+            for bucket in set(self._bucket_ids)
+        )
+
+    def __iter__(self):
+        cfg = self.cfg
+        for bucket in sorted(set(self._bucket_ids)):
+            idx = [i for i, b in enumerate(self._bucket_ids) if b == bucket]
+            for s in range(0, len(idx), self.batch_images):
+                chunk = idx[s:s + self.batch_images]
+                n = len(chunk)
+                bh, bw = bucket
+                images = np.zeros((n, bh, bw, 3), np.float32)
+                im_info = np.zeros((n, 3), np.float32)
+                scales = np.zeros((n,), np.float32)
+                for j, i in enumerate(chunk):
+                    rec = self.roidb[i]
+                    img, im_scale = load_and_transform(
+                        rec["image"], False, cfg.network.pixel_means,
+                        cfg.bucket.scale, cfg.bucket.max_size, bucket)
+                    images[j] = img
+                    im_info[j] = (round(rec["height"] * im_scale),
+                                  round(rec["width"] * im_scale), im_scale)
+                    scales[j] = im_scale
+                g = cfg.train.max_gt_boxes
+                batch = Batch(
+                    images, im_info,
+                    np.zeros((n, g, 4), np.float32),
+                    np.zeros((n, g), np.int32),
+                    np.zeros((n, g), bool),
+                )
+                yield batch, chunk, scales

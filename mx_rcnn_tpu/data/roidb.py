@@ -1,0 +1,100 @@
+"""roidb abstraction: the per-image annotation records all loaders consume.
+
+Reference: ``rcnn/dataset/imdb.py — IMDB`` (gt_roidb pkl cache,
+``append_flipped_images``, ``merge_roidbs``) and ``rcnn/utils/load_data.py``
+(``load_gt_roidb``, ``merge_roidb``, ``filter_roidb``).
+
+A roidb entry is a plain dict (same keys as the reference where they
+matter):
+  ``image`` (path), ``height``, ``width``,
+  ``boxes`` (n, 4) float32 gt boxes (x1, y1, x2, y2),
+  ``gt_classes`` (n,) int32 class ids (1..C-1),
+  ``flipped`` bool.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+Roidb = List[Dict]
+
+
+class IMDB:
+    """Image database base class (ref ``rcnn/dataset/imdb.py — IMDB``)."""
+
+    def __init__(self, name: str, image_set: str, root_path: str,
+                 dataset_path: str):
+        self.name = f"{name}_{image_set}"
+        self.image_set = image_set
+        self.root_path = root_path
+        self.data_path = dataset_path
+        self.classes: Sequence[str] = []
+        self.num_images = 0
+
+    @property
+    def num_classes(self) -> int:
+        return len(self.classes)
+
+    @property
+    def cache_path(self) -> str:
+        path = os.path.join(self.root_path, "cache")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def gt_roidb(self) -> Roidb:
+        """Load ground-truth annotations, cached as a pkl (ref gt_roidb)."""
+        cache_file = os.path.join(self.cache_path, self.name + "_gt_roidb.pkl")
+        if os.path.exists(cache_file):
+            with open(cache_file, "rb") as f:
+                return pickle.load(f)
+        roidb = self._load_annotations()
+        with open(cache_file, "wb") as f:
+            pickle.dump(roidb, f, pickle.HIGHEST_PROTOCOL)
+        return roidb
+
+    def _load_annotations(self) -> Roidb:
+        raise NotImplementedError
+
+    def evaluate_detections(self, all_boxes) -> Dict[str, float]:
+        """all_boxes[class][image] = (k, 5) array of [x1 y1 x2 y2 score]."""
+        raise NotImplementedError
+
+    @staticmethod
+    def append_flipped_images(roidb: Roidb) -> Roidb:
+        """Double the roidb with horizontally flipped copies
+        (ref ``append_flipped_images``; boxes mirrored as x' = W-1-x)."""
+        flipped = []
+        for rec in roidb:
+            boxes = rec["boxes"].copy()
+            if boxes.size:
+                x1 = boxes[:, 0].copy()
+                x2 = boxes[:, 2].copy()
+                boxes[:, 0] = rec["width"] - x2 - 1
+                boxes[:, 2] = rec["width"] - x1 - 1
+                assert (boxes[:, 2] >= boxes[:, 0]).all()
+            new = dict(rec)
+            new["boxes"] = boxes
+            new["flipped"] = True
+            flipped.append(new)
+        return list(roidb) + flipped
+
+
+def merge_roidbs(roidbs: Sequence[Roidb]) -> Roidb:
+    """Concatenate roidbs of multiple image sets (ref merge_roidb; used for
+    VOC07+12 training)."""
+    out: Roidb = []
+    for r in roidbs:
+        out.extend(r)
+    return out
+
+
+def filter_roidb(roidb: Roidb) -> Roidb:
+    """Drop images without any gt box (ref filter_roidb drops entries with
+    neither fg nor bg ROIs; with on-device sampling the only fatal case is
+    an image with zero annotations)."""
+    keep = [r for r in roidb if len(r["boxes"]) > 0]
+    return keep
