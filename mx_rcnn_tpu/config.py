@@ -217,7 +217,10 @@ _NETWORKS: Mapping[str, Mapping[str, Any]] = {
     # tiny test images still contain in-image anchors
     "tiny": dict(
         name="tiny", depth=0, rcnn_pooled_size=(7, 7),
-        anchor_scales=(1, 2, 4), fixed_params=(), fixed_params_shared=(),
+        # 32/64/128-px anchors: cover both the 128-px unit-test canvases and
+        # the synthetic dataset's 320x400 canvases (objects span 1/5..1/2 of
+        # the canvas in data/synthetic.py)
+        anchor_scales=(2, 4, 8), fixed_params=(), fixed_params_shared=(),
         compute_dtype="float32",
     ),
 }
@@ -237,6 +240,23 @@ _DATASETS: Mapping[str, Mapping[str, Any]] = {
         dataset_path="data/coco",
         num_classes=81,
     ),
+    # download-free generated dataset (data/synthetic.py) — the end-to-end
+    # train→eval gate runs on it; no reference equivalent
+    "synthetic": dict(
+        name="synthetic",
+        image_set="train",
+        test_image_set="test",
+        dataset_path="data/synthetic",
+        num_classes=4,
+    ),
+}
+
+# Per-dataset bucket presets (TPU addition): synthetic canvases are
+# 320x400, so resizing them to the VOC 600/1000 scale would only waste
+# compute on interpolated pixels.
+_DATASET_BUCKETS: Mapping[str, Mapping[str, Any]] = {
+    "synthetic": dict(scale=320, max_size=416,
+                      shapes=((320, 416), (416, 320))),
 }
 
 
@@ -257,6 +277,8 @@ def generate_config(network: str = "resnet101", dataset: str = "PascalVOC",
         network=NetworkConfig(**_NETWORKS[network]),
         dataset=DatasetConfig(**_DATASETS[dataset]),
     )
+    if dataset in _DATASET_BUCKETS:
+        cfg = cfg.replace_in("bucket", **_DATASET_BUCKETS[dataset])
     by_section: dict = {}
     for key, val in overrides.items():
         if "__" not in key:

@@ -1,0 +1,149 @@
+"""The training driver: epochs of the jitted step + logging + checkpoints.
+
+Reference: ``rcnn/core/module.py — MutableModule.fit`` (the train loop:
+forward_backward → update → metric update → batch_end_callback →
+epoch_end_callback) plus ``rcnn/core/callback.py — Speedometer`` (imgs/sec
+every ``frequent`` batches) and ``do_checkpoint`` (per-epoch save).
+
+TPU-native: the loop body is ONE jitted XLA program (single device) or one
+SPMD program over a mesh (``parallel/dp.py``); metrics come back as device
+scalars and are only synced to host at log time so the async dispatch
+pipeline stays full between logs.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.core.train import Batch, TrainState, make_train_step
+from mx_rcnn_tpu.models.faster_rcnn import FasterRCNN
+from mx_rcnn_tpu.utils.checkpoint import save_checkpoint
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+
+class Speedometer:
+    """imgs/sec + running metric means every ``frequent`` batches
+    (ref ``rcnn/core/callback.py — Speedometer``).
+
+    Call once per batch; pass the averaged metrics on log batches (the fit
+    loop aligns those with its metric window) and it prints samples/sec over
+    the batches elapsed since the previous log line.
+    """
+
+    def __init__(self, batch_size: int, frequent: int = 20,
+                 log: Callable[[str], None] = None):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.log = log or logger.info
+        self._tic = time.perf_counter()
+        self._since = 0
+
+    def reset(self) -> None:
+        """Call at epoch start so the first window excludes checkpoint-save
+        and summary time from the previous epoch."""
+        self._tic = time.perf_counter()
+        self._since = 0
+
+    def __call__(self, epoch: int, nbatch: int,
+                 metrics: Dict[str, float]) -> None:
+        self._since += 1
+        if not metrics:
+            return
+        elapsed = time.perf_counter() - self._tic
+        speed = self._since * self.batch_size / max(elapsed, 1e-9)
+        parts = ", ".join(f"{k}={v:.4f}" for k, v in metrics.items())
+        self.log(f"Epoch[{epoch}] Batch [{nbatch}] "
+                 f"Speed: {speed:.2f} samples/sec, {parts}")
+        self._tic = time.perf_counter()
+        self._since = 0
+
+
+def _mean_metrics(window: List[Dict]) -> Dict[str, float]:
+    """Host-side mean of a window of device metric dicts (one sync)."""
+    if not window:
+        return {}
+    window = jax.device_get(window)
+    keys = window[0].keys()
+    return {k: float(np.mean([m[k] for m in window])) for k in keys}
+
+
+def fit(
+    model: FasterRCNN,
+    cfg: Config,
+    state: TrainState,
+    tx,
+    train_loader,
+    num_epochs: int,
+    key: jax.Array,
+    begin_epoch: int = 0,
+    prefix: Optional[str] = None,
+    frequent: Optional[int] = None,
+    mesh=None,
+    epoch_end_callback: Optional[Callable[[int, TrainState], None]] = None,
+) -> TrainState:
+    """Run ``begin_epoch .. num_epochs`` epochs; checkpoint per epoch.
+
+    ``mesh``: a 1-D ``jax.sharding.Mesh`` enables data-parallel SPMD (the
+    kvstore='device' replacement); None = single-device jit.
+    ``key`` is the base RNG; the step folds in ``state.step`` so resuming
+    from a checkpoint replays the identical sample stream.
+    """
+    frequent = cfg.default.frequent if frequent is None else frequent
+    if mesh is not None and mesh.size > 1:
+        from mx_rcnn_tpu.parallel.dp import (
+            make_dp_train_step, replicate, shard_batch)
+
+        step_fn = make_dp_train_step(model, cfg, tx, mesh)
+        state = replicate(state, mesh)
+
+        def run_step(state, batch: Batch):
+            return step_fn(state, shard_batch(batch, mesh), key)
+    else:
+        base = jax.jit(make_train_step(model, cfg, tx), donate_argnums=(0,))
+
+        def run_step(state, batch: Batch):
+            return base(state, batch, key)
+
+    n_dev = mesh.size if mesh is not None else 1
+    speedo = Speedometer(cfg.train.batch_images * n_dev, frequent)
+    for epoch in range(begin_epoch, num_epochs):
+        if hasattr(train_loader, "set_epoch"):
+            train_loader.set_epoch(epoch)  # resume-exact shuffle order
+        speedo.reset()
+        window: List[Dict] = []
+        epoch_metrics: List[Dict] = []
+        t0 = time.perf_counter()
+        nbatch = 0
+        for batch in train_loader:
+            state, metrics = run_step(state, batch)
+            window.append(metrics)
+            nbatch += 1
+            if nbatch % frequent == 0:
+                avg = _mean_metrics(window)
+                epoch_metrics.append(avg)
+                window = []
+                speedo(epoch, nbatch, avg)
+            else:
+                speedo(epoch, nbatch, {})
+        if window:
+            epoch_metrics.append(_mean_metrics(window))
+        if epoch_metrics:
+            keys = epoch_metrics[0].keys()
+            summary = ", ".join(
+                f"{k}={np.mean([m[k] for m in epoch_metrics]):.4f}"
+                for k in keys)
+            logger.info("Epoch[%d] Train summary: %s  (%.1fs)", epoch,
+                        summary, time.perf_counter() - t0)
+        if prefix is not None:
+            path = save_checkpoint(prefix, epoch + 1, state)
+            logger.info('Epoch[%d] Saved checkpoint to "%s"', epoch, path)
+        if epoch_end_callback is not None:
+            epoch_end_callback(epoch, state)
+    return state

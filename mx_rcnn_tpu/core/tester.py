@@ -39,7 +39,9 @@ class Predictor:
         self.cfg = cfg
         self._fns: Dict[Tuple[int, ...], callable] = {}
 
-    def __call__(self, images: np.ndarray, im_info: np.ndarray):
+    def raw(self, images: np.ndarray, im_info: np.ndarray):
+        """Forward pass returning DEVICE arrays (no host sync) — the eval
+        loop feeds these straight into the jitted postprocess."""
         shape = tuple(images.shape)
         if shape not in self._fns:
             model = self.model
@@ -49,16 +51,64 @@ class Predictor:
                 return model.apply(variables, images, im_info)
 
             self._fns[shape] = fn
-        rois, roi_valid, cls_prob, deltas = self._fns[shape](
+        return self._fns[shape](
             self.variables, jnp.asarray(images), jnp.asarray(im_info))
+
+    def __call__(self, images: np.ndarray, im_info: np.ndarray):
+        rois, roi_valid, cls_prob, deltas = self.raw(images, im_info)
         return (np.asarray(rois), np.asarray(roi_valid),
                 np.asarray(cls_prob), np.asarray(deltas))
 
 
-@functools.partial(jax.jit, static_argnames=("nms_thresh",))
-def _per_class_nms(boxes: jnp.ndarray, scores: jnp.ndarray, valid: jnp.ndarray,
-                   nms_thresh: float) -> jnp.ndarray:
-    return nms_mask(boxes, scores, nms_thresh, valid=valid)
+@jax.jit
+def _decode_batch(rois, roi_valid, cls_prob, deltas, im_info, scales,
+                  stds, means):
+    """De-normalize deltas, decode, clip, unscale — the shared decode step
+    of eval (ref ``im_detect``).  Returns (boxes (N, R, 4C) in raw-image
+    coordinates, scores (N, R, C) with padded ROI slots zeroed)."""
+
+    def one(rois_i, valid_i, prob_i, d_i, info_i, scale_i):
+        d = d_i * stds + means  # de-normalization invariant (see docstring)
+        boxes = bbox_pred(rois_i, d)
+        boxes = clip_boxes(boxes, (info_i[0], info_i[1]))
+        boxes = boxes / scale_i  # back to raw image coordinates
+        scores = prob_i * valid_i[:, None]  # padded ROI slots → 0
+        return boxes, scores
+
+    return jax.vmap(one)(rois, roi_valid, cls_prob, deltas, im_info, scales)
+
+
+@functools.partial(jax.jit, static_argnames=("nms_thresh", "score_thresh"))
+def _postprocess_batch(rois, roi_valid, cls_prob, deltas, im_info, scales,
+                       stds, means, *, nms_thresh: float, score_thresh: float):
+    """Decode + clip + unscale + per-class masked NMS for a whole batch in
+    ONE fixed-shape XLA program.
+
+    The reference runs per-class NMS on host with variable-length candidate
+    lists (``pred_eval``); a naive port jits per candidate-count and
+    recompiles hundreds of times.  Here every class runs masked NMS over the
+    full fixed ROI buffer (valid = score>thresh), vmapped over classes and
+    images, so eval compiles once per batch shape.
+
+    Returns (boxes (N, R, 4C) raw-image coords, scores (N, R, C),
+    keep (N, C, R) bool — the post-NMS per-class detection mask).
+    """
+    n, r, c4 = deltas.shape
+    c = cls_prob.shape[-1]
+    boxes_b, scores_b = _decode_batch(rois, roi_valid, cls_prob, deltas,
+                                      im_info, scales, stds, means)
+
+    def one(boxes, scores, valid_i):
+        boxes_c = boxes.reshape(r, c, 4).transpose(1, 0, 2)  # (C, R, 4)
+        scores_c = scores.T  # (C, R)
+        cand = (scores_c > score_thresh) & valid_i[None, :]
+        keep = jax.vmap(
+            lambda b, s, v: nms_mask(b, s, nms_thresh, valid=v)
+        )(boxes_c, scores_c, cand)
+        return keep & cand
+
+    keep_b = jax.vmap(one)(boxes_b, scores_b, roi_valid)
+    return boxes_b, scores_b, keep_b
 
 
 def im_detect_batch(
@@ -79,18 +129,15 @@ def im_detect_batch(
     """
     n, r, c4 = deltas.shape
     num_classes = c4 // 4
-    stds = np.tile(np.asarray(cfg.train.bbox_stds, np.float32), num_classes)
-    means = np.tile(np.asarray(cfg.train.bbox_means, np.float32), num_classes)
-    out = []
-    for i in range(n):
-        d = deltas[i] * stds + means
-        boxes = np.asarray(bbox_pred(jnp.asarray(rois[i]), jnp.asarray(d)))
-        boxes = np.asarray(clip_boxes(jnp.asarray(boxes),
-                                      (im_info[i, 0], im_info[i, 1])))
-        boxes = boxes / scales[i]  # back to raw image coordinates
-        scores = cls_prob[i] * roi_valid[i][:, None]  # padded slots → 0
-        out.append((boxes, scores))
-    return out
+    stds = jnp.tile(jnp.asarray(cfg.train.bbox_stds, jnp.float32),
+                    num_classes)
+    means = jnp.tile(jnp.asarray(cfg.train.bbox_means, jnp.float32),
+                     num_classes)
+    boxes_b, scores_b = map(np.asarray, _decode_batch(
+        jnp.asarray(rois), jnp.asarray(roi_valid), jnp.asarray(cls_prob),
+        jnp.asarray(deltas), jnp.asarray(im_info), jnp.asarray(scales),
+        stds, means))
+    return [(boxes_b[i], scores_b[i]) for i in range(n)]
 
 
 def pred_eval(predictor: Predictor, test_loader, imdb, cfg: Config,
@@ -105,26 +152,30 @@ def pred_eval(predictor: Predictor, test_loader, imdb, cfg: Config,
         for _ in range(num_classes)
     ]
     thresh = cfg.test.score_thresh
+    stds = jnp.tile(jnp.asarray(cfg.train.bbox_stds, jnp.float32),
+                    num_classes)
+    means = jnp.tile(jnp.asarray(cfg.train.bbox_means, jnp.float32),
+                     num_classes)
     done = 0
     for batch, indices, scales in test_loader:
-        rois, roi_valid, cls_prob, deltas = predictor(batch.images,
-                                                      batch.im_info)
-        decoded = im_detect_batch(rois, roi_valid, cls_prob, deltas,
-                                  batch.im_info, scales, cfg)
+        # device arrays stay on device between forward and postprocess
+        rois, roi_valid, cls_prob, deltas = predictor.raw(batch.images,
+                                                          batch.im_info)
+        boxes_b, scores_b, keep_b = map(np.asarray, _postprocess_batch(
+            rois, roi_valid, cls_prob, deltas, jnp.asarray(batch.im_info),
+            jnp.asarray(scales), stds, means,
+            nms_thresh=cfg.test.nms, score_thresh=thresh))
+        r = boxes_b.shape[1]
         for j, i in enumerate(indices):
-            boxes, scores = decoded[j]
+            boxes = boxes_b[j].reshape(r, num_classes, 4)
+            scores = scores_b[j]
             kept_all = []
             for c in range(1, num_classes):
-                inds = scores[:, c] > thresh
-                if not inds.any():
+                keep = keep_b[j, c]
+                if not keep.any():
                     continue
-                cls_boxes = boxes[inds, 4 * c:4 * c + 4]
-                cls_scores = scores[inds, c]
-                keep = np.asarray(_per_class_nms(
-                    jnp.asarray(cls_boxes), jnp.asarray(cls_scores),
-                    jnp.ones(len(cls_scores), bool), cfg.test.nms))
-                dets = np.hstack([cls_boxes[keep],
-                                  cls_scores[keep, None]]).astype(np.float32)
+                dets = np.hstack([boxes[keep, c],
+                                  scores[keep, c, None]]).astype(np.float32)
                 all_boxes[c][i] = dets
                 kept_all.append(dets[:, 4])
             # cap detections per image by score (ref max_per_image=100)

@@ -34,3 +34,39 @@ def get_dataset(name: str, image_set: str, root_path: str, dataset_path: str,
     if name not in table:
         raise KeyError(f"unknown dataset {name!r}; have {sorted(table)}")
     return table[name](image_set, root_path, dataset_path, **kw)
+
+
+def load_gt_roidb(cfg, image_set: str = None, training: bool = True,
+                  flip: bool = None, **kw):
+    """Config → (imdb, roidb): the full roidb assembly used by the entry
+    points (ref ``rcnn/utils/load_data.py — load_gt_roidb`` + ``merge_roidb``
+    + ``filter_roidb``; VOC07+12 is expressed as a '+'-joined image_set,
+    e.g. ``2007_trainval+2012_trainval``, exactly like the reference CLI).
+
+    Training mode appends flipped copies (ref TRAIN.FLIP) and filters
+    images without gt; eval mode does neither.  Returns the FIRST imdb
+    (the evaluator) and the merged roidb.
+    """
+    ds = cfg.dataset
+    if image_set is None:
+        image_set = ds.image_set if training else ds.test_image_set
+    if not training and "+" in image_set:
+        # pred_eval hands all detections to the FIRST imdb's evaluator,
+        # which only scores its own images — a merged eval set would
+        # silently drop the later sets from the reported mAP
+        raise ValueError(
+            f"'+'-joined image sets are train-only; got {image_set!r}")
+    if ds.name == "synthetic":
+        kw.setdefault("num_classes", ds.num_classes)
+    imdbs, roidbs = [], []
+    for sset in image_set.split("+"):
+        imdb = get_dataset(ds.name, sset, ds.root_path, ds.dataset_path, **kw)
+        r = imdb.gt_roidb()
+        if training:
+            r = filter_roidb(r)
+            do_flip = cfg.train.flip if flip is None else flip
+            if do_flip:
+                r = IMDB.append_flipped_images(r)
+        imdbs.append(imdb)
+        roidbs.append(r)
+    return imdbs[0], merge_roidbs(roidbs)

@@ -37,6 +37,18 @@ def imread_rgb(path: str) -> np.ndarray:
         return np.asarray(im.convert("RGB"))
 
 
+def compute_scale(h: int, w: int, target_size: int, max_size: int) -> float:
+    """The reference resize rule (``rcnn/io/image.py — resize``): scale so
+    the short side hits ``target_size`` unless that pushes the long side
+    past ``max_size``.  Single source of truth — the loader predicts bucket
+    membership with the same formula the resize applies."""
+    short, long = min(h, w), max(h, w)
+    scale = float(target_size) / short
+    if round(scale * long) > max_size:
+        scale = float(max_size) / long
+    return scale
+
+
 def resize_keep_ratio(img: np.ndarray, target_size: int, max_size: int
                       ) -> Tuple[np.ndarray, float]:
     """Scale so the short side is ``target_size`` without the long side
@@ -45,10 +57,7 @@ def resize_keep_ratio(img: np.ndarray, target_size: int, max_size: int
     Returns (resized image, scale factor).
     """
     h, w = img.shape[:2]
-    short, long = min(h, w), max(h, w)
-    scale = float(target_size) / short
-    if round(scale * long) > max_size:
-        scale = float(max_size) / long
+    scale = compute_scale(h, w, target_size, max_size)
     new_w, new_h = int(round(w * scale)), int(round(h * scale))
     if _HAS_CV2:
         out = cv2.resize(img, (new_w, new_h), interpolation=cv2.INTER_LINEAR)

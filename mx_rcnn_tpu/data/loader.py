@@ -24,17 +24,15 @@ import numpy as np
 
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.core.train import Batch
-from mx_rcnn_tpu.data.image import choose_bucket, load_and_transform
+from mx_rcnn_tpu.data.image import (choose_bucket, compute_scale,
+                                    load_and_transform)
 from mx_rcnn_tpu.data.roidb import Roidb
 
 
 def _bucket_of(rec, buckets, scale, max_size) -> Tuple[int, int]:
     """Bucket for a roidb record after reference resizing."""
     h, w = rec["height"], rec["width"]
-    short, long = min(h, w), max(h, w)
-    s = scale / short
-    if round(s * long) > max_size:
-        s = max_size / long
+    s = compute_scale(h, w, scale, max_size)
     return choose_bucket(int(round(h * s)), int(round(w * s)), buckets)
 
 
@@ -52,7 +50,8 @@ class AnchorLoader:
         self.cfg = cfg
         self.batch_images = batch_images or cfg.train.batch_images
         self.shuffle = shuffle
-        self._rng = np.random.RandomState(seed)
+        self.seed = seed
+        self._epoch = 0
         b = cfg.bucket
         self.buckets = tuple(tuple(s) for s in b.shapes)
         self._bucket_ids = [
@@ -95,12 +94,26 @@ class AnchorLoader:
                 gt_valid[j, :k] = True
         return Batch(images, im_info, gt_boxes, gt_classes, gt_valid)
 
+    def set_epoch(self, epoch: int) -> None:
+        """Pin the shuffle order of the NEXT iteration to ``epoch``.
+
+        The shuffle RNG is derived from (seed, epoch), so a run resumed at
+        epoch k replays the identical batch order the uninterrupted run saw
+        — required for the bit-exact-resume invariant (utils/checkpoint.py).
+        The fit loop calls this each epoch; without it, iterating advances
+        the epoch automatically.
+        """
+        self._epoch = epoch
+
     def __iter__(self) -> Iterator[Batch]:
+        rng = np.random.RandomState(
+            (self.seed * 1_000_003 + self._epoch) % (2 ** 31))
+        self._epoch += 1
         order_by_bucket = {}
-        for bucket in set(self._bucket_ids):
+        for bucket in sorted(set(self._bucket_ids)):
             idx = self._indices_for(bucket)
             if self.shuffle:
-                self._rng.shuffle(idx)
+                rng.shuffle(idx)
             order_by_bucket[bucket] = idx
         # interleave buckets batch-by-batch (ref shuffles group pairs)
         batches = []
@@ -109,7 +122,7 @@ class AnchorLoader:
                            self.batch_images):
                 batches.append((bucket, idx[s:s + self.batch_images]))
         if self.shuffle:
-            self._rng.shuffle(batches)
+            rng.shuffle(batches)
         for bucket, indices in batches:
             yield self._make_batch(indices, bucket)
 

@@ -118,6 +118,8 @@ class PascalVOC(IMDB):
         ``all_boxes[class][image] = (k, 5)`` arrays.
         """
         use_07 = True  # ref uses the 11-point metric for VOC07
+        if out_dir is not None:
+            self.write_detections(all_boxes, out_dir)
         gt = {}
         for i, index in enumerate(self.image_index):
             rec = self._gt_for_eval(index)

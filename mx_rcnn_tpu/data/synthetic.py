@@ -11,6 +11,7 @@ integration-level correctness check of the entire framework.
 from __future__ import annotations
 
 import os
+import zlib
 from typing import Dict, List
 
 import numpy as np
@@ -26,8 +27,10 @@ def _class_color(c: int) -> np.ndarray:
 
 class SyntheticDataset(IMDB):
     def __init__(self, image_set: str, root_path: str, dataset_path: str,
-                 num_images: int = 32, num_classes: int = 21,
-                 image_size=(320, 400), max_objects: int = 4):
+                 num_images: int = None, num_classes: int = 4,
+                 image_size=(320, 400), max_objects: int = 3):
+        if num_images is None:
+            num_images = 64 if "train" in image_set else 16
         super().__init__("synthetic", image_set, root_path,
                          dataset_path or os.path.join(root_path, "synthetic"))
         self.classes = ["__background__"] + [
@@ -35,11 +38,22 @@ class SyntheticDataset(IMDB):
         self.num_images = num_images
         self.image_size = image_size
         self.max_objects = max_objects
-        seed = abs(hash(image_set)) % (2 ** 31)
+        # stable across processes (str hash() is PYTHONHASHSEED-randomized,
+        # which would regenerate different images each run and desync any
+        # cached PNGs from the in-memory ground truth)
+        seed = zlib.crc32(image_set.encode()) % (2 ** 31)
         self._rng = np.random.RandomState(seed)
         self.image_dir = os.path.join(self.data_path, self.image_set)
         self._specs = self._make_specs()
         self.image_index = list(range(num_images))
+
+    @staticmethod
+    def _iou(a, b) -> float:
+        ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]) + 1)
+        iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]) + 1)
+        inter = ix * iy
+        area = lambda r: (r[2] - r[0] + 1) * (r[3] - r[1] + 1)
+        return inter / (area(a) + area(b) - inter)
 
     def _make_specs(self) -> List[Dict]:
         h, w = self.image_size
@@ -48,13 +62,21 @@ class SyntheticDataset(IMDB):
             n = self._rng.randint(1, self.max_objects + 1)
             boxes, classes = [], []
             for _ in range(n):
-                # object sizes scale with the canvas so tiny test images work
-                bw = self._rng.randint(max(16, w // 5), max(17, w // 2))
-                bh = self._rng.randint(max(16, h // 5), max(17, h // 2))
-                x1 = self._rng.randint(0, w - bw)
-                y1 = self._rng.randint(0, h - bh)
-                boxes.append([x1, y1, x1 + bw - 1, y1 + bh - 1])
-                classes.append(self._rng.randint(1, self.num_classes))
+                # rejection-sample low-overlap placements: heavily overlapping
+                # solid rectangles occlude each other (later draws overwrite
+                # earlier pixels), which would make gt boxes unlearnable and
+                # the eval mAP ceiling ill-defined
+                for _attempt in range(20):
+                    # object sizes scale with the canvas so tiny images work
+                    bw = self._rng.randint(max(16, w // 5), max(17, w // 2))
+                    bh = self._rng.randint(max(16, h // 5), max(17, h // 2))
+                    x1 = self._rng.randint(0, w - bw)
+                    y1 = self._rng.randint(0, h - bh)
+                    cand = [x1, y1, x1 + bw - 1, y1 + bh - 1]
+                    if all(self._iou(cand, b) < 0.2 for b in boxes):
+                        boxes.append(cand)
+                        classes.append(self._rng.randint(1, self.num_classes))
+                        break
             specs.append(dict(
                 boxes=np.asarray(boxes, np.float32),
                 gt_classes=np.asarray(classes, np.int32),
@@ -74,11 +96,26 @@ class SyntheticDataset(IMDB):
     def image_path(self, i: int) -> str:
         return os.path.join(self.image_dir, f"{self.image_set}_{i:05d}.png")
 
+    def _spec_signature(self) -> str:
+        """Content hash of the generation parameters + all gt.  The PNG cache
+        is only valid for exactly this dataset; reusing stale pixels against
+        fresh in-memory gt would silently break the learnable-color
+        invariant the dataset exists for."""
+        h = zlib.crc32(repr((self.num_images, self.num_classes,
+                             self.image_size, self.max_objects)).encode())
+        for spec in self._specs:
+            h = zlib.crc32(spec["boxes"].tobytes(), h)
+            h = zlib.crc32(spec["gt_classes"].tobytes(), h)
+            h = zlib.crc32(str(spec["noise_seed"]).encode(), h)
+        return f"{h:08x}"
+
     def _materialize(self) -> None:
         os.makedirs(self.image_dir, exist_ok=True)
+        stamp = os.path.join(self.image_dir, f".spec-{self._spec_signature()}")
+        fresh = os.path.exists(stamp)
         for i, spec in enumerate(self._specs):
             path = self.image_path(i)
-            if not os.path.exists(path):
+            if not fresh or not os.path.exists(path):
                 img = self._render(spec)
                 try:
                     import cv2
@@ -88,6 +125,15 @@ class SyntheticDataset(IMDB):
                     from PIL import Image
 
                     Image.fromarray(img).save(path)
+        if not fresh:
+            # drop stamps of other configurations: their pixels were just
+            # overwritten, so leaving them would validate a stale cache if
+            # that configuration is ever requested again (A→B→A pattern)
+            for name in os.listdir(self.image_dir):
+                if name.startswith(".spec-"):
+                    os.unlink(os.path.join(self.image_dir, name))
+            with open(stamp, "w"):
+                pass
 
     def _load_annotations(self) -> Roidb:
         self._materialize()

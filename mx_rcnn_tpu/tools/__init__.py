@@ -1,0 +1,7 @@
+"""User entry points (ref layer L7: ``train_end2end.py``, ``test.py``,
+``demo.py``, ``train_alternate.py`` and the ``rcnn/tools/`` stage drivers).
+
+Each module is runnable as ``python -m mx_rcnn_tpu.tools.<name>`` and also
+exposes a function API (``train_net``, ``test_rcnn``, ...) so tests and the
+alternate-training driver can call them in-process.
+"""

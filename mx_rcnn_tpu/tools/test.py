@@ -1,0 +1,78 @@
+"""Detection evaluation entry point: checkpoint → mAP.
+
+Reference: ``test.py — test_rcnn`` (SURVEY.md §3.2): generate_config →
+test symbol → TestLoader → Predictor → ``pred_eval`` → per-class NMS →
+``imdb.evaluate_detections``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+from typing import Dict
+
+from mx_rcnn_tpu.config import Config, generate_config
+from mx_rcnn_tpu.core.tester import Predictor, pred_eval
+from mx_rcnn_tpu.data import TestLoader, load_gt_roidb
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.utils.checkpoint import load_param
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+
+def test_rcnn(cfg: Config, *, prefix: str, epoch: int,
+              image_set: str = None, out_dir: str = None,
+              verbose: bool = True, dataset_kw: dict = None
+              ) -> Dict[str, float]:
+    """Evaluate checkpoint ``prefix``@``epoch``; returns the metric dict
+    (includes ``mAP`` for VOC-style evaluators)."""
+    imdb, roidb = load_gt_roidb(cfg, image_set=image_set, training=False,
+                                **(dataset_kw or {}))
+    loader = TestLoader(roidb, cfg)
+    model = build_model(cfg)
+    params, batch_stats = load_param(prefix, epoch)
+    predictor = Predictor(
+        model, {"params": params, "batch_stats": batch_stats}, cfg)
+    results = pred_eval(predictor, loader, imdb, cfg, out_dir=out_dir,
+                        verbose=verbose)
+    for k, v in sorted(results.items()):
+        logger.info("%s AP = %.4f", k, v)
+    if "mAP" in results:
+        print(f"mAP = {results['mAP']:.4f}")
+    return results
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        description="Evaluate a Faster R-CNN checkpoint (ref test.py)")
+    p.add_argument("--network", default="resnet101",
+                   choices=["vgg", "resnet50", "resnet101", "tiny"])
+    p.add_argument("--dataset", default="PascalVOC",
+                   choices=["PascalVOC", "coco", "synthetic"])
+    p.add_argument("--image_set", default=None,
+                   help="defaults to the dataset's test_image_set")
+    p.add_argument("--root_path", default=None)
+    p.add_argument("--dataset_path", default=None)
+    p.add_argument("--prefix", default="model/e2e")
+    p.add_argument("--epoch", type=int, required=True)
+    p.add_argument("--out_dir", default=None,
+                   help="write detection files here (VOC comp4 / COCO json)")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    args = parse_args(argv)
+    overrides = {}
+    if args.root_path:
+        overrides["dataset__root_path"] = args.root_path
+    if args.dataset_path:
+        overrides["dataset__dataset_path"] = args.dataset_path
+    cfg = generate_config(args.network, args.dataset, **overrides)
+    test_rcnn(cfg, prefix=args.prefix, epoch=args.epoch,
+              image_set=args.image_set, out_dir=args.out_dir)
+
+
+if __name__ == "__main__":
+    main()

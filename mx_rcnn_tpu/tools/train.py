@@ -1,0 +1,149 @@
+"""End-to-end Faster R-CNN training entry point.
+
+Reference: ``train_end2end.py — parse_args / train_net`` (SURVEY.md §3.1):
+argparse → generate_config → load_gt_roidb(flip) → AnchorLoader → pretrained
+init → MutableModule.fit(sgd, Speedometer, do_checkpoint).
+
+TPU-native: same CLI surface and flow, but the fit loop runs ONE jitted XLA
+program per step (``core/fit.py``) and multi-device training is a
+``shard_map`` mesh instead of a ctx list + kvstore: ``--num-devices N``
+replaces ``--gpus 0,..,N-1`` (``kvstore='device'`` ≙ in-step pmean over
+ICI, see ``parallel/dp.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+import jax
+
+from mx_rcnn_tpu.config import Config, generate_config
+from mx_rcnn_tpu.core.fit import fit
+from mx_rcnn_tpu.core.train import setup_training
+from mx_rcnn_tpu.data import AnchorLoader, load_gt_roidb
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.utils.checkpoint import restore_state
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+
+def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
+              end_epoch: int = None, lr: float = None, lr_step: str = None,
+              num_devices: int = 1, frequent: int = None, seed: int = 0,
+              pretrained: str = None, pretrained_epoch: int = 0,
+              roidb=None, dataset_kw: dict = None,
+              frozen_prefixes=None):
+    """Train end-to-end; returns the final TrainState.
+
+    ``roidb`` may be injected (the alternate-training driver does); when
+    None it is loaded from ``cfg.dataset``.
+    """
+    if end_epoch is None:
+        end_epoch = cfg.default.e2e_epoch
+    if roidb is None:
+        _, roidb = load_gt_roidb(cfg, training=True, **(dataset_kw or {}))
+    logger.info("training on %d roidb images", len(roidb))
+
+    n_total = cfg.train.batch_images * num_devices
+    loader = AnchorLoader(roidb, cfg, batch_images=n_total,
+                          shuffle=cfg.train.shuffle, seed=seed)
+    steps_per_epoch = max(len(loader), 1)
+    logger.info("%d batches/epoch (global batch %d)", steps_per_epoch,
+                n_total)
+
+    model = build_model(cfg)
+    bh, bw = cfg.bucket.shapes[0]
+    key = jax.random.PRNGKey(seed)
+    state, tx = setup_training(
+        model, cfg, key, (cfg.train.batch_images, bh, bw, 3),
+        steps_per_epoch, base_lr=lr, lr_step=lr_step,
+        frozen_prefixes=frozen_prefixes)
+
+    if pretrained:
+        from mx_rcnn_tpu.utils.pretrained import load_pretrained_into
+
+        state = load_pretrained_into(state, pretrained, pretrained_epoch, cfg)
+    if begin_epoch > 0:
+        state = restore_state(state, prefix, begin_epoch)
+        logger.info("resumed from %s epoch %d", prefix, begin_epoch)
+
+    mesh = None
+    if num_devices > 1:
+        from mx_rcnn_tpu.parallel.dp import device_mesh
+
+        mesh = device_mesh(num_devices)
+    state = fit(model, cfg, state, tx, loader, end_epoch, key,
+                begin_epoch=begin_epoch, prefix=prefix, frequent=frequent,
+                mesh=mesh)
+    return state
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        description="Train Faster R-CNN end-to-end (ref train_end2end.py)")
+    p.add_argument("--network", default="resnet101",
+                   choices=["vgg", "resnet50", "resnet101", "tiny"])
+    p.add_argument("--dataset", default="PascalVOC",
+                   choices=["PascalVOC", "coco", "synthetic"])
+    p.add_argument("--image_set", default=None,
+                   help="e.g. 2007_trainval or 2007_trainval+2012_trainval")
+    p.add_argument("--root_path", default=None)
+    p.add_argument("--dataset_path", default=None)
+    p.add_argument("--prefix", default="model/e2e")
+    p.add_argument("--pretrained", default=None,
+                   help="pretrained backbone checkpoint prefix/path")
+    p.add_argument("--pretrained_epoch", type=int, default=0)
+    p.add_argument("--begin_epoch", type=int, default=0)
+    p.add_argument("--end_epoch", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--lr_step", default=None)
+    p.add_argument("--frequent", type=int, default=None,
+                   help="Speedometer logging period (batches)")
+    p.add_argument("--batch_images", type=int, default=None,
+                   help="images per device (ref BATCH_IMAGES)")
+    p.add_argument("--num_devices", type=int, default=1,
+                   help="data-parallel devices (ref --gpus)")
+    p.add_argument("--no_flip", action="store_true")
+    p.add_argument("--no_shuffle", action="store_true")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest checkpoint under --prefix")
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    args = parse_args(argv)
+    overrides = {}
+    if args.image_set:
+        overrides["dataset__image_set"] = args.image_set
+    if args.root_path:
+        overrides["dataset__root_path"] = args.root_path
+    if args.dataset_path:
+        overrides["dataset__dataset_path"] = args.dataset_path
+    if args.batch_images:
+        overrides["train__batch_images"] = args.batch_images
+    if args.no_flip:
+        overrides["train__flip"] = False
+    if args.no_shuffle:
+        overrides["train__shuffle"] = False
+    cfg = generate_config(args.network, args.dataset, **overrides)
+
+    begin_epoch = args.begin_epoch
+    if args.resume and begin_epoch == 0:
+        from mx_rcnn_tpu.utils.checkpoint import latest_checkpoint
+
+        found = latest_checkpoint(args.prefix)
+        if found:
+            begin_epoch = found[0]
+    train_net(cfg, prefix=args.prefix, begin_epoch=begin_epoch,
+              end_epoch=args.end_epoch, lr=args.lr, lr_step=args.lr_step,
+              num_devices=args.num_devices, frequent=args.frequent,
+              seed=args.seed, pretrained=args.pretrained,
+              pretrained_epoch=args.pretrained_epoch)
+
+
+if __name__ == "__main__":
+    main()

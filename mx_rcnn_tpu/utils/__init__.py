@@ -1,0 +1,11 @@
+"""Utility layer: checkpoint/param I/O (ref ``rcnn/utils/``)."""
+
+from mx_rcnn_tpu.utils.checkpoint import (  # noqa: F401
+    checkpoint_path,
+    combine_model,
+    latest_checkpoint,
+    load_checkpoint,
+    load_param,
+    restore_state,
+    save_checkpoint,
+)

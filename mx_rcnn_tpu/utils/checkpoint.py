@@ -1,0 +1,122 @@
+"""Checkpoint / parameter I/O.
+
+Reference: ``rcnn/core/callback.py — do_checkpoint`` (per-epoch
+``prefix-%04d.params``), ``rcnn/utils/load_model.py — load_checkpoint /
+load_param``, ``rcnn/utils/save_model.py — save_checkpoint`` and
+``rcnn/utils/combine_model.py — combine_model``.
+
+Design differences from the reference:
+
+* The reference saves MXNet NDArray containers and **un-normalizes the
+  bbox_pred weights by the bbox target means/stds at save time** so exported
+  models emit raw deltas; the training copy keeps normalized weights.  Here
+  (see ``core/tester.py`` docstring) weights always stay in normalized space
+  and the predictor de-normalizes at decode time, so a checkpoint is both
+  the export format AND the resume format — no weight rewriting, resume is
+  bit-exact.
+* One file per epoch, msgpack-serialized (flax.serialization) full
+  ``TrainState`` — params, frozen batch_stats, optimizer slots, step.
+  ``load_param`` reads just the model variables out of the same file (the
+  analog of loading ``prefix-%04d.params`` without optimizer state).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+
+def checkpoint_path(prefix: str, epoch: int) -> str:
+    """``prefix-%04d.ckpt`` (ref naming: ``prefix-%04d.params``)."""
+    return f"{prefix}-{epoch:04d}.ckpt"
+
+
+def save_checkpoint(prefix: str, epoch: int, state) -> str:
+    """Serialize a full TrainState (params, batch_stats, opt_state, step).
+
+    Ref ``do_checkpoint`` epoch_end_callback; returns the written path.
+    """
+    path = checkpoint_path(prefix, epoch)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    state = jax.device_get(state)
+    payload = serialization.to_state_dict(state)
+    data = serialization.msgpack_serialize(payload)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)  # atomic: a crash mid-write can't corrupt the epoch
+    return path
+
+
+def load_checkpoint(prefix: str, epoch: int) -> Dict[str, Any]:
+    """Raw nested-dict view of a checkpoint (no template needed)."""
+    with open(checkpoint_path(prefix, epoch), "rb") as f:
+        return serialization.msgpack_restore(f.read())
+
+
+def restore_state(template_state, prefix: str, epoch: int):
+    """Restore a full TrainState onto a freshly-built template
+    (``setup_training`` output) — shapes/structure must match.
+
+    Ref analog: ``load_param`` + ``begin_epoch=N`` resume in train_net.
+    """
+    raw = load_checkpoint(prefix, epoch)
+    return serialization.from_state_dict(template_state, raw)
+
+
+def load_param(prefix: str, epoch: int) -> Tuple[Dict, Dict]:
+    """(params, batch_stats) from a checkpoint — the eval/export view
+    (ref ``load_param(prefix, epoch)`` → arg_params, aux_params)."""
+    raw = load_checkpoint(prefix, epoch)
+    return raw["params"], raw.get("batch_stats", {})
+
+
+def latest_checkpoint(prefix: str, max_epoch: int = 1000
+                      ) -> Optional[Tuple[int, str]]:
+    """Highest-epoch checkpoint under ``prefix``, or None."""
+    best = None
+    d = os.path.dirname(prefix) or "."
+    base = os.path.basename(prefix)
+    if not os.path.isdir(d):
+        return None
+    for name in os.listdir(d):
+        if name.startswith(base + "-") and name.endswith(".ckpt"):
+            stem = name[len(base) + 1:-5]
+            if stem.isdigit():
+                e = int(stem)
+                if e <= max_epoch and (best is None or e > best[0]):
+                    best = (e, os.path.join(d, name))
+    return best
+
+
+def _matches(name: str, prefixes: Iterable[str]) -> bool:
+    return any(name.startswith(p) for p in prefixes)
+
+
+def combine_model(params_a: Dict, params_b: Dict,
+                  from_a: Iterable[str]) -> Dict:
+    """Merge two param trees by top-level module name: names matching a
+    ``from_a`` prefix come from ``params_a``, the rest from ``params_b``.
+
+    Ref ``rcnn/utils/combine_model.py — combine_model`` merges the RPN-stage
+    and RCNN-stage checkpoints into the final alternate-training model: RPN
+    weights (and shared convs) from the rpn2 checkpoint, RCNN head weights
+    from the rcnn2 checkpoint.
+    """
+    from_a = tuple(from_a)
+    out = dict(params_b)
+    for name, sub in params_a.items():
+        if _matches(name, from_a):
+            out[name] = sub
+    return out
+
+
+def tree_size_bytes(tree) -> int:
+    """Total parameter bytes (for logging)."""
+    return sum(np.asarray(x).nbytes for x in jax.tree.leaves(tree))

@@ -1,0 +1,98 @@
+"""Checkpoint/resume tests.
+
+Reference invariants (SURVEY.md §5.4): per-epoch params files, resume via
+``load_param`` + ``begin_epoch``.  The TPU design strengthens this to
+bit-exact resume: a restored TrainState must continue producing the exact
+same parameter trajectory as an uninterrupted run (the step folds
+``state.step`` into the RNG, so the sample stream is position-indexed).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.test_train_step import KEY, make_batch, tiny_setup
+
+from mx_rcnn_tpu.core.train import make_train_step
+from mx_rcnn_tpu.utils.checkpoint import (
+    checkpoint_path,
+    combine_model,
+    latest_checkpoint,
+    load_param,
+    restore_state,
+    save_checkpoint,
+)
+
+
+def test_save_restore_bit_exact_resume(tmp_path):
+    cfg, model, tx, state = tiny_setup()
+    step = jax.jit(make_train_step(model, cfg, tx))
+    batches = [make_batch(seed=s) for s in range(5)]
+
+    # uninterrupted: 3 + 2 steps, checkpoint after step 3
+    s = state
+    for b in batches[:3]:
+        s, _ = step(s, b, KEY)
+    prefix = os.path.join(str(tmp_path), "model", "ckpt")
+    save_checkpoint(prefix, 3, s)
+    for b in batches[3:]:
+        s, _ = step(s, b, KEY)
+
+    # resumed: fresh template, restore epoch-3 checkpoint, same 2 steps
+    _, _, _, template = tiny_setup()
+    r = restore_state(template, prefix, 3)
+    assert int(r.step) == 3
+    for b in batches[3:]:
+        r, _ = step(r, b, KEY)
+
+    for pa, pb in zip(jax.tree.leaves(s.params), jax.tree.leaves(r.params)):
+        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+    for oa, ob in zip(jax.tree.leaves(s.opt_state),
+                      jax.tree.leaves(r.opt_state)):
+        np.testing.assert_array_equal(np.asarray(oa), np.asarray(ob))
+    assert int(r.step) == int(s.step) == 5
+
+
+def test_load_param_roundtrip(tmp_path):
+    _, _, _, state = tiny_setup()
+    prefix = str(tmp_path / "m")
+    path = save_checkpoint(prefix, 1, state)
+    assert path == checkpoint_path(prefix, 1)
+    params, batch_stats = load_param(prefix, 1)
+    orig = jax.tree.leaves(state.params)
+    rest = jax.tree.leaves(params)
+    assert len(orig) == len(rest)
+    for a, b in zip(orig, rest):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_latest_checkpoint(tmp_path):
+    _, _, _, state = tiny_setup()
+    prefix = str(tmp_path / "run" / "e2e")
+    assert latest_checkpoint(prefix) is None
+    for e in (1, 2, 10):
+        save_checkpoint(prefix, e, state)
+    epoch, path = latest_checkpoint(prefix)
+    assert epoch == 10 and path.endswith("e2e-0010.ckpt")
+
+
+def test_combine_model():
+    a = {"backbone": {"w": jnp.ones(2)}, "rpn": {"w": jnp.ones(2) * 2},
+         "cls_score": {"w": jnp.ones(2) * 3}}
+    b = {"backbone": {"w": jnp.zeros(2)}, "rpn": {"w": jnp.zeros(2)},
+         "cls_score": {"w": jnp.zeros(2) * 0}, "bbox_pred": {"w": jnp.ones(1)}}
+    merged = combine_model(a, b, from_a=("rpn", "backbone"))
+    assert float(merged["rpn"]["w"][0]) == 2.0
+    assert float(merged["backbone"]["w"][0]) == 1.0
+    assert float(merged["cls_score"]["w"][0]) == 0.0
+    assert "bbox_pred" in merged
+
+
+def test_checkpoint_file_is_atomic(tmp_path):
+    _, _, _, state = tiny_setup()
+    prefix = str(tmp_path / "m")
+    save_checkpoint(prefix, 1, state)
+    assert not os.path.exists(checkpoint_path(prefix, 1) + ".tmp")
