@@ -103,10 +103,14 @@ class NetworkConfig:
     rcnn_pooled_size: Tuple[int, int] = (14, 14)  # ref: VGG 7x7, ResNet 14x14
     # Parameter-name prefixes frozen during training (ref: FIXED_PARAMS) and
     # the larger set frozen in alternate-training shared-conv stages
-    # (ref: FIXED_PARAMS_SHARED).
-    fixed_params: Tuple[str, ...] = ("conv0", "stage1", "bn0", "bn_data")
+    # (ref: FIXED_PARAMS_SHARED).  'gamma'/'beta' are the reference's
+    # freeze-every-BN-affine tokens (see core/optim.frozen_mask); stage4 is
+    # the per-ROI head and must stay trainable in shared-conv stages.
+    fixed_params: Tuple[str, ...] = (
+        "conv0", "stage1", "bn0", "bn_data", "gamma", "beta")
     fixed_params_shared: Tuple[str, ...] = (
-        "conv0", "stage1", "stage2", "stage3", "stage4", "bn0", "bn_data")
+        "conv0", "stage1", "stage2", "stage3", "bn0", "bn_data",
+        "gamma", "beta")
     # -- TPU additions -------------------------------------------------------
     depth: int = 101                     # resnet depth (50 / 101 / 152)
     compute_dtype: str = "bfloat16"      # MXU-friendly activation dtype

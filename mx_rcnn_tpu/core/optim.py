@@ -46,13 +46,29 @@ def frozen_mask(params, fixed_prefixes: Iterable[str]):
     A parameter is frozen when any path component starts with one of the
     reference's FIXED_PARAMS prefixes (ref MutableModule fixed_param_prefix
     matching by substring of the MXNet param name).
+
+    The reference ResNet FIXED_PARAMS also lists ``'gamma'``/``'beta'`` —
+    MXNet names every BN affine ``*_gamma``/``*_beta``, so those two tokens
+    freeze the affine of EVERY BatchNorm network-wide (statistics are frozen
+    anyway; training an affine against frozen stats with weight decay is the
+    divergence ADVICE r1 flagged).  Here the equivalent leaves are
+    ``scale``/``bias`` directly under a ``bn*`` scope.
     """
     prefixes = tuple(fixed_prefixes)
+    freeze_gamma = "gamma" in prefixes
+    freeze_beta = "beta" in prefixes
 
     def trainable(path: Tuple, _leaf) -> bool:
         names = [getattr(k, "key", str(k)) for k in path]
         for name in names:
             if any(name.startswith(p) for p in prefixes):
+                return False
+        leaf = names[-1] if names else ""
+        parent = names[-2] if len(names) > 1 else ""
+        if parent.startswith("bn"):
+            if freeze_gamma and leaf == "scale":
+                return False
+            if freeze_beta and leaf == "bias":
                 return False
         return True
 

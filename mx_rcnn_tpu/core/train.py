@@ -151,8 +151,12 @@ def loss_and_metrics(
     bbox_deltas = bbox_deltas.astype(jnp.float32)
 
     labels = pt.labels.reshape(-1)
+    # ref RCNN loss is normalization='batch', but the reference never emits
+    # filler ROIs (sample_rois fills all BATCH_ROIS slots), so its batch
+    # denominator always equals the valid count; 'valid' is the faithful
+    # generalization when the proposal pool is too small to fill every slot
     rcnn_cls_loss = softmax_cross_entropy_with_ignore(
-        cls_logits, labels, -1, "batch")
+        cls_logits, labels, -1, "valid")
     rcnn_bbox_loss = weighted_smooth_l1(
         bbox_deltas, pt.bbox_targets.reshape(bbox_deltas.shape),
         pt.bbox_weights.reshape(bbox_deltas.shape),

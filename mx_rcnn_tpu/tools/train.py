@@ -64,6 +64,7 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
         from mx_rcnn_tpu.utils.pretrained import load_pretrained_into
 
         state = load_pretrained_into(state, pretrained, pretrained_epoch, cfg)
+        logger.info("grafted pretrained backbone from %s", pretrained)
     if begin_epoch > 0:
         state = restore_state(state, prefix, begin_epoch)
         logger.info("resumed from %s epoch %d", prefix, begin_epoch)

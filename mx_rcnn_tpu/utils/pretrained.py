@@ -1,0 +1,337 @@
+"""Pretrained backbone import.
+
+Reference: ``train_end2end.py`` initializes from ImageNet checkpoints via
+``load_param(pretrained, epoch)`` (``rcnn/utils/load_model.py``), grafting
+``arg_params``/``aux_params`` onto the symbol and Normal-initializing only
+the new detection layers (rpn_*, cls_score, bbox_pred).
+
+This module reproduces that flow for the Flax param tree.  Because this
+machine has no MXNet and no network access, three weight sources are
+supported:
+
+* ``*.params`` — the MXNet NDArray container the reference actually ships
+  (e.g. ``resnet-101-0000.params``).  Parsed standalone (no mxnet import);
+  see :func:`_parse_mxnet_params` for the documented binary layout.
+* ``*.npz`` — ``np.savez`` with the same ``arg:<name>`` / ``aux:<name>``
+  keys (the documented offline conversion: ``mx.nd.load`` → ``np.savez``).
+* ``*.pth``/``*.pt`` — a torch state_dict.  Only VGG16 (torchvision
+  layout) is mappable: torchvision ResNets are post-activation (v1) while
+  the reference network is pre-activation (v2) — their BN placement does
+  not correspond, so ResNet weights must come from the MXNet zoo formats
+  above.
+
+Naming map (MXNet → this repo, ResNet-v2 zoo names):
+  ``bn_data_gamma``                → ``params/backbone/bn_data/scale``
+  ``conv0_weight`` (OIHW)         → ``params/backbone/conv0/kernel`` (HWIO)
+  ``stage1_unit1_bn1_gamma``      → ``params/backbone/stage1_unit1/bn1/scale``
+  ``stage1_unit1_sc_weight``      → ``.../stage1_unit1/sc/kernel``
+  ``stage4_*`` / final ``bn1_*``  → ``params/head/...`` (per-ROI stage)
+  ``aux:*_moving_mean/var``       → ``batch_stats/.../mean|var``
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, Tuple
+
+import jax
+import numpy as np
+
+# MXNet serialization constants (dmlc/mxnet ndarray.cc)
+_LIST_MAGIC = 0x112
+_NDARRAY_V1 = 0xF993FAC8  # int64 shape
+_NDARRAY_V2 = 0xF993FAC9  # + storage type
+_NDARRAY_V3 = 0xF993FACA
+_DTYPES = {0: np.float32, 1: np.float64, 2: np.float16, 3: np.uint8,
+           4: np.int32, 5: np.int8, 6: np.int64}
+
+
+def _parse_mxnet_params(path: str) -> Dict[str, np.ndarray]:
+    """Standalone parser for the MXNet NDArray container format.
+
+    Layout (little-endian):
+      uint64 list_magic (0x112), uint64 reserved
+      uint64 n_arrays, then per array (NDArray::Save):
+        uint32 magic
+          V2/V3: int32 storage_type (-1 dense), uint32 ndim, int64 dims[]
+          V1:    uint32 ndim, int64 dims[]
+          legacy: magic IS ndim, uint32 dims[]
+        int32 dev_type, int32 dev_id, int32 type_flag
+        uint64 data_bytes? — NOT present: data follows immediately with
+        prod(shape) * sizeof(dtype) bytes
+      uint64 n_names, then per name: uint64 len, bytes
+    """
+    with open(path, "rb") as f:
+        data = f.read()
+    off = 0
+
+    def u32():
+        nonlocal off
+        (v,) = struct.unpack_from("<I", data, off)
+        off += 4
+        return v
+
+    def i32():
+        nonlocal off
+        (v,) = struct.unpack_from("<i", data, off)
+        off += 4
+        return v
+
+    def u64():
+        nonlocal off
+        (v,) = struct.unpack_from("<Q", data, off)
+        off += 8
+        return v
+
+    if u64() != _LIST_MAGIC:
+        raise ValueError(f"{path}: not an MXNet NDArray container")
+    u64()  # reserved
+    n = u64()
+    arrays = []
+    for _ in range(n):
+        magic = u32()
+        if magic in (_NDARRAY_V2, _NDARRAY_V3):
+            stype = i32()
+            if stype != -1:
+                raise ValueError(f"{path}: sparse arrays unsupported")
+            ndim = u32()
+            shape = struct.unpack_from(f"<{ndim}q", data, off)
+            off += 8 * ndim
+        elif magic == _NDARRAY_V1:
+            ndim = u32()
+            shape = struct.unpack_from(f"<{ndim}q", data, off)
+            off += 8 * ndim
+        else:  # legacy: magic was the ndim of a uint32 shape
+            ndim = magic
+            if ndim > 8:
+                raise ValueError(f"{path}: unrecognized ndarray header")
+            shape = struct.unpack_from(f"<{ndim}I", data, off)
+            off += 4 * ndim
+        i32()  # dev_type
+        i32()  # dev_id
+        type_flag = i32()
+        dt = _DTYPES[type_flag]
+        count = int(np.prod(shape)) if ndim else 1
+        arr = np.frombuffer(data, dt, count, off).reshape(shape)
+        off += count * np.dtype(dt).itemsize
+        arrays.append(arr.copy())
+    n_names = u64()
+    names = []
+    for _ in range(n_names):
+        ln = u64()
+        names.append(data[off:off + ln].decode())
+        off += ln
+    return dict(zip(names, arrays))
+
+
+def load_raw(path: str) -> Dict[str, np.ndarray]:
+    """Read any supported weight file into a flat name→array dict."""
+    ext = os.path.splitext(path)[1]
+    if ext == ".params":
+        return _parse_mxnet_params(path)
+    if ext == ".npz":
+        return dict(np.load(path))
+    if ext in (".pth", ".pt"):
+        import torch
+
+        sd = torch.load(path, map_location="cpu", weights_only=True)
+        return {k: v.numpy() for k, v in sd.items()}
+    raise ValueError(f"unsupported pretrained format: {path}")
+
+
+def _strip(raw: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Drop the 'arg:'/'aux:' prefixes MXNet uses in checkpoint files."""
+    return {k.split(":", 1)[-1]: v for k, v in raw.items()}
+
+
+def _conv_kernel(w: np.ndarray) -> np.ndarray:
+    """OIHW (mxnet/torch) → HWIO (flax)."""
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+def map_mxnet_resnet(raw: Dict[str, np.ndarray]
+                     ) -> Tuple[Dict, Dict]:
+    """MXNet resnet-v2 zoo names → (params updates, batch_stats updates).
+
+    ``stage4_*`` and the closing ``bn1`` belong to the per-ROI head module
+    (ref runs conv5 per ROI — ``symbol_resnet.py`` get_resnet_train).
+    """
+    raw = _strip(raw)
+    params: Dict = {"backbone": {}, "head": {}}
+    stats: Dict = {"backbone": {}, "head": {}}
+
+    def put(tree, module, scope, leaf, value):
+        node = tree.setdefault(module, {})
+        parts = scope.split("/") + [leaf]
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = np.asarray(value, np.float32)
+
+    for name, arr in raw.items():
+        if name.startswith("fc1_") or name.startswith("softmax"):
+            continue  # ImageNet classifier — not part of the detector
+        module = "backbone"
+        scope = None
+        if name.startswith("stage4_"):
+            module = "head"
+        if name.startswith("bn1_"):
+            module = "head"  # closing bn1 follows stage4 in the ref symbol
+        # split trailing leaf
+        for suffix, dest, leaf in (
+            ("_gamma", "params", "scale"), ("_beta", "params", "bias"),
+            ("_moving_mean", "stats", "mean"),
+            ("_moving_var", "stats", "var"),
+            ("_weight", "params", "kernel"), ("_bias", "params", "bias"),
+        ):
+            if name.endswith(suffix):
+                base = name[: -len(suffix)]
+                # stageX_unitY_bnZ → stageX_unitY/bnZ ; conv0/bn0/bn_data flat
+                if base.startswith("stage"):
+                    scope_parts = base.split("_")
+                    scope = "_".join(scope_parts[:2]) + "/" + "_".join(
+                        scope_parts[2:])
+                else:
+                    scope = base
+                value = arr
+                if leaf == "kernel" and arr.ndim == 4:
+                    value = _conv_kernel(arr)
+                put(params if dest == "params" else stats, module, scope,
+                    leaf, value)
+                break
+    return params, stats
+
+
+# torchvision vgg16 'features.N' indices → reference conv names
+_TV_VGG16 = {
+    0: "conv1_1", 2: "conv1_2", 5: "conv2_1", 7: "conv2_2",
+    10: "conv3_1", 12: "conv3_2", 14: "conv3_3",
+    17: "conv4_1", 19: "conv4_2", 21: "conv4_3",
+    24: "conv5_1", 26: "conv5_2", 28: "conv5_3",
+}
+
+
+def _fc_kernel_chw_to_hwc(w: np.ndarray, c: int, h: int, w_: int
+                          ) -> np.ndarray:
+    """(out, C*H*W) fc weight → (H*W*C, out) for an NHWC flatten."""
+    out = w.shape[0]
+    return (w.reshape(out, c, h, w_).transpose(2, 3, 1, 0)
+            .reshape(h * w_ * c, out))
+
+
+def map_vgg16(raw: Dict[str, np.ndarray], pooled=(7, 7)) -> Tuple[Dict, Dict]:
+    """VGG16 weights → (params updates, {}).  Accepts torchvision
+    (``features.N.weight``/``classifier.N.weight``) or MXNet zoo
+    (``conv1_1_weight``/``fc6_weight``) naming.  fc6 kernels are permuted
+    from the source's CHW flatten to this repo's NHWC flatten."""
+    raw = _strip(raw)
+    params: Dict = {"backbone": {}, "head": {}}
+    ph, pw = pooled
+    for name, arr in raw.items():
+        if name.startswith("features."):
+            idx = int(name.split(".")[1])
+            leaf = name.split(".")[2]
+            conv_name = _TV_VGG16.get(idx)
+            if conv_name is None:
+                continue
+            val = _conv_kernel(arr) if leaf == "weight" else arr
+            params["backbone"].setdefault(conv_name, {})[
+                "kernel" if leaf == "weight" else "bias"] = np.asarray(
+                    val, np.float32)
+        elif name.startswith("classifier."):
+            idx = int(name.split(".")[1])
+            leaf = name.split(".")[2]
+            fc = {0: "fc6", 3: "fc7"}.get(idx)
+            if fc is None:
+                continue  # classifier.6 = ImageNet fc8
+            val = arr
+            if leaf == "weight":
+                val = (_fc_kernel_chw_to_hwc(arr, 512, ph, pw) if fc == "fc6"
+                       else arr.T)
+            params["head"].setdefault(fc, {})[
+                "kernel" if leaf == "weight" else "bias"] = np.asarray(
+                    val, np.float32)
+        elif name.split("_")[0].startswith("conv"):
+            base, leaf = name.rsplit("_", 1)
+            val = _conv_kernel(arr) if (leaf == "weight" and arr.ndim == 4) \
+                else arr
+            params["backbone"].setdefault(base, {})[
+                "kernel" if leaf == "weight" else "bias"] = np.asarray(
+                    val, np.float32)
+        elif name.startswith(("fc6_", "fc7_")):
+            fc, leaf = name.split("_", 1)
+            val = arr
+            if leaf == "weight":
+                val = (_fc_kernel_chw_to_hwc(arr, 512, ph, pw) if fc == "fc6"
+                       else arr.T)
+            params["head"].setdefault(fc, {})[
+                "kernel" if leaf == "weight" else "bias"] = np.asarray(
+                    val, np.float32)
+    return params, {}
+
+
+def _graft(tree: Dict, updates: Dict, path: str = "") -> int:
+    """Overwrite matching leaves of ``tree`` with ``updates`` in place;
+    returns the number of leaves written.  Shape mismatches raise."""
+    n = 0
+    for k, v in updates.items():
+        if isinstance(v, dict):
+            if k not in tree:
+                raise KeyError(f"pretrained scope {path}/{k} not in model")
+            n += _graft(tree[k], v, f"{path}/{k}")
+        else:
+            cur = tree.get(k)
+            if cur is None:
+                raise KeyError(f"pretrained leaf {path}/{k} not in model")
+            if tuple(np.shape(cur)) != tuple(v.shape):
+                raise ValueError(
+                    f"shape mismatch at {path}/{k}: model "
+                    f"{np.shape(cur)} vs pretrained {v.shape}")
+            tree[k] = v
+            n += 1
+    return n
+
+
+def _count_leaves(tree) -> int:
+    return len(jax.tree.leaves(tree))
+
+
+def load_pretrained_into(state, path: str, epoch: int, cfg):
+    """Graft pretrained backbone(+head trunk) weights onto a TrainState
+    (the analog of ``load_param`` + selective init in train_net).
+
+    ``epoch`` is accepted for reference CLI parity: ``prefix`` + epoch name
+    a ``.params`` file when ``path`` has no extension.
+    Asserts FULL coverage of the backbone parameter tree (and batch_stats
+    for ResNet) — a partly-initialized backbone trains to garbage silently.
+    """
+    if not os.path.splitext(path)[1]:
+        path = f"{path}-{epoch:04d}.params"
+    raw = load_raw(path)
+    name = cfg.network.name
+    if name.startswith("resnet"):
+        p_up, s_up = map_mxnet_resnet(raw)
+    elif name == "vgg":
+        p_up, s_up = map_vgg16(raw, cfg.network.rcnn_pooled_size)
+    else:
+        raise ValueError(f"no pretrained mapping for network {name!r}")
+
+    params = jax.tree.map(lambda x: x, state.params)  # copy
+    stats = jax.tree.map(lambda x: x, state.batch_stats)
+    wrote = _graft(params, p_up)
+    if s_up:
+        wrote += _graft(stats, s_up)
+    # full-coverage check on the backbone AND the pretrained head trunk
+    # (resnet stage4/bn1, VGG fc6/fc7) — a partly-initialized trunk trains
+    # to garbage as silently as a partly-initialized backbone
+    for module in ("backbone", "head"):
+        need = _count_leaves(state.params[module])
+        got = _count_leaves(p_up.get(module, {}))
+        if name.startswith("resnet"):
+            need += _count_leaves(state.batch_stats.get(module, {}))
+            got += _count_leaves(s_up.get(module, {}))
+        if got < need:
+            raise ValueError(
+                f"pretrained file covers {got}/{need} {module} leaves — "
+                f"refusing a partly-initialized {module}")
+    return state._replace(params=params, batch_stats=stats)

@@ -1,0 +1,221 @@
+"""Unit tests for the eval core (``core/tester.py``) — VERDICT r1 item 3.
+
+Covers the decode math (de-normalize → bbox_pred → clip → unscale), the
+Predictor per-shape jit cache, ``_postprocess_batch`` NMS/threshold
+semantics, ``pred_eval`` end-to-end against a fabricated perfect predictor
+(must score mAP=1.0), the ``max_per_image`` cap, and
+``generate_proposals`` output structure/ordering.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.core.tester import (
+    Predictor,
+    _postprocess_batch,
+    generate_proposals,
+    im_detect_batch,
+    pred_eval,
+)
+from mx_rcnn_tpu.data import TestLoader, load_gt_roidb
+from mx_rcnn_tpu.models import build_model
+
+
+def _toy_cfg(num_classes=3):
+    cfg = generate_config("tiny", "synthetic",
+                          dataset__num_classes=num_classes)
+    cfg = cfg.replace_in("bucket", scale=128, max_size=160,
+                         shapes=((128, 160), (160, 128)))
+    cfg = cfg.replace_in("test", rpn_pre_nms_top_n=256, rpn_post_nms_top_n=32)
+    return cfg
+
+
+def test_im_detect_batch_golden():
+    """Hand-computed decode: delta de-normalization, identity decode for
+    zero deltas, clipping, and un-scaling back to raw coordinates."""
+    cfg = _toy_cfg(num_classes=2)
+    # one image, two ROIs, two classes (bg + 1)
+    rois = np.array([[[10.0, 10.0, 29.0, 29.0],
+                      [0.0, 0.0, 19.0, 39.0]]], np.float32)
+    roi_valid = np.array([[True, False]])
+    cls_prob = np.array([[[0.1, 0.9], [0.2, 0.8]]], np.float32)
+    # zero deltas → decoded box == roi for every class
+    deltas = np.zeros((1, 2, 8), np.float32)
+    # normalized dx=1 for class1 of roi0: raw dx = 1*std_x(0.1)+mean(0) = 0.1
+    deltas[0, 0, 4] = 1.0
+    im_info = np.array([[100.0, 100.0, 2.0]], np.float32)
+    scales = np.array([2.0], np.float32)
+    (boxes, scores), = im_detect_batch(rois, roi_valid, cls_prob, deltas,
+                                       im_info, scales, cfg)
+    # class 0 of roi0: identity decode, then /2 scale
+    np.testing.assert_allclose(boxes[0, 0:4], rois[0, 0] / 2.0, atol=1e-5)
+    # class 1 of roi0: dx=0.1 shifts the center by 0.1*width (width=20)
+    w = 20.0
+    expected = (rois[0, 0] + np.array([0.1 * w, 0, 0.1 * w, 0])) / 2.0
+    np.testing.assert_allclose(boxes[0, 4:8], expected, atol=1e-4)
+    # invalid ROI slot → zero scores
+    np.testing.assert_allclose(scores[1], 0.0)
+    assert scores[0, 1] == pytest.approx(0.9)
+
+
+def test_im_detect_batch_clips_to_image():
+    cfg = _toy_cfg(num_classes=1)
+    rois = np.array([[[-5.0, -7.0, 200.0, 300.0]]], np.float32)
+    roi_valid = np.array([[True]])
+    cls_prob = np.ones((1, 1, 1), np.float32)
+    deltas = np.zeros((1, 1, 4), np.float32)
+    im_info = np.array([[50.0, 60.0, 1.0]], np.float32)
+    scales = np.array([1.0], np.float32)
+    (boxes, _), = im_detect_batch(rois, roi_valid, cls_prob, deltas,
+                                  im_info, scales, cfg)
+    assert boxes[0, 0] >= 0 and boxes[0, 1] >= 0
+    assert boxes[0, 2] <= 59.0 and boxes[0, 3] <= 49.0
+
+
+def test_postprocess_batch_nms_and_threshold():
+    cfg = _toy_cfg(num_classes=2)
+    # three ROIs: two overlapping (IoU>0.3), one distant low-score
+    rois = np.array([[[10, 10, 50, 50], [12, 12, 52, 52],
+                      [80, 80, 120, 120]]], np.float32)
+    roi_valid = np.array([[True, True, True]])
+    cls_prob = np.array([[[0.1, 0.9], [0.4, 0.6], [1.0, 1e-5]]], np.float32)
+    deltas = np.zeros((1, 3, 8), np.float32)
+    im_info = np.array([[160.0, 160.0, 1.0]], np.float32)
+    scales = np.array([1.0], np.float32)
+    stds = jnp.tile(jnp.asarray(cfg.train.bbox_stds), 2)
+    means = jnp.tile(jnp.asarray(cfg.train.bbox_means), 2)
+    boxes, scores, keep = map(np.asarray, _postprocess_batch(
+        jnp.asarray(rois), jnp.asarray(roi_valid), jnp.asarray(cls_prob),
+        jnp.asarray(deltas), jnp.asarray(im_info), jnp.asarray(scales),
+        stds, means, nms_thresh=0.3, score_thresh=1e-3))
+    k = keep[0, 1]  # class 1
+    assert k[0]          # highest score survives
+    assert not k[1]      # suppressed by overlap with roi0
+    assert not k[2]      # below score threshold
+    # a padded (invalid) ROI can never be kept
+    roi_valid2 = np.array([[True, True, False]])
+    cls_prob2 = np.array([[[0.1, 0.9], [0.4, 0.6], [0.0, 1.0]]], np.float32)
+    _, _, keep2 = map(np.asarray, _postprocess_batch(
+        jnp.asarray(rois), jnp.asarray(roi_valid2), jnp.asarray(cls_prob2),
+        jnp.asarray(deltas), jnp.asarray(im_info), jnp.asarray(scales),
+        stds, means, nms_thresh=0.3, score_thresh=1e-3))
+    assert not keep2[0, 1, 2]
+
+
+def test_predictor_shape_cache():
+    cfg = _toy_cfg()
+    model = build_model(cfg)
+    images = np.zeros((1, 128, 160, 3), np.float32)
+    im_info = np.array([[128.0, 160.0, 1.0]], np.float32)
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                    jnp.asarray(images),
+                                    jnp.asarray(im_info))
+    pred = Predictor(model, variables, cfg)
+    pred(images, im_info)
+    assert len(pred._fns) == 1
+    pred(images, im_info)  # same shape → cached
+    assert len(pred._fns) == 1
+    pred(np.zeros((1, 160, 128, 3), np.float32),
+         np.array([[160.0, 128.0, 1.0]], np.float32))
+    assert len(pred._fns) == 2
+    rois, roi_valid, cls_prob, deltas = pred(images, im_info)
+    r = cfg.test.rpn_post_nms_top_n
+    assert rois.shape == (1, r, 4)
+    assert cls_prob.shape == (1, r, cfg.num_classes)
+    assert deltas.shape == (1, r, 4 * cfg.num_classes)
+
+
+class _PerfectPredictor:
+    """Fabricated predictor: emits every gt box of the image with an
+    almost-one-hot class probability — pred_eval must score mAP = 1.0."""
+
+    def __init__(self, roidb, cfg, r=16):
+        self.roidb = roidb
+        self.cfg = cfg
+        self.r = r
+        self._cursor = 0
+
+    def raw(self, images, im_info):
+        n = images.shape[0]
+        c = self.cfg.num_classes
+        rois = np.zeros((n, self.r, 4), np.float32)
+        valid = np.zeros((n, self.r), bool)
+        prob = np.zeros((n, self.r, c), np.float32)
+        prob[:, :, 0] = 1.0  # default: confident background
+        deltas = np.zeros((n, self.r, 4 * c), np.float32)
+        for j in range(n):
+            rec = self.roidb[self._cursor + j]
+            scale = im_info[j, 2]
+            k = len(rec["boxes"])
+            rois[j, :k] = rec["boxes"] * scale
+            valid[j, :k] = True
+            for t in range(k):
+                prob[j, t, :] = 0.0
+                # distinct scores: the max_per_image cap keeps score TIES
+                # (>= threshold, matching the reference), so equal scores
+                # would defeat it
+                prob[j, t, rec["gt_classes"][t]] = 0.95 - 0.01 * t
+        self._cursor += n
+        return (jnp.asarray(rois), jnp.asarray(valid), jnp.asarray(prob),
+                jnp.asarray(deltas))
+
+
+def test_pred_eval_perfect_predictor_scores_map_1(tmp_path):
+    cfg = _toy_cfg(num_classes=4)
+    cfg = cfg.replace_in(
+        "dataset", root_path=str(tmp_path),
+        dataset_path=str(tmp_path / "synthetic"))
+    kw = dict(num_images=6, image_size=(128, 160), max_objects=3)
+    imdb, roidb = load_gt_roidb(cfg, training=False, **kw)
+    loader = TestLoader(roidb, cfg)
+    pred = _PerfectPredictor(roidb, cfg)
+    results = pred_eval(pred, loader, imdb, cfg, verbose=False)
+    assert results["mAP"] == pytest.approx(1.0)
+
+
+def test_pred_eval_max_per_image_cap(tmp_path):
+    cfg = _toy_cfg(num_classes=4)
+    cfg = cfg.replace_in(
+        "dataset", root_path=str(tmp_path),
+        dataset_path=str(tmp_path / "synthetic"))
+    cfg = cfg.replace_in("test", max_per_image=1)
+    kw = dict(num_images=4, image_size=(128, 160), max_objects=3)
+    imdb, roidb = load_gt_roidb(cfg, training=False, **kw)
+    loader = TestLoader(roidb, cfg)
+    pred = _PerfectPredictor(roidb, cfg)
+    # run the loop manually to inspect detection counts per image
+    num_classes = imdb.num_classes
+    results = pred_eval(pred, loader, imdb, cfg, verbose=False)
+    # with at most 1 det/image, images holding >1 object cannot all be
+    # found: mAP must drop below 1 iff some image has 2+ objects
+    multi = any(len(r["boxes"]) > 1 for r in roidb)
+    if multi:
+        assert results["mAP"] < 1.0
+    else:  # degenerate draw — still a valid run
+        assert results["mAP"] == pytest.approx(1.0)
+
+
+def test_generate_proposals_structure(tmp_path):
+    cfg = _toy_cfg(num_classes=4)
+    cfg = cfg.replace_in(
+        "dataset", root_path=str(tmp_path),
+        dataset_path=str(tmp_path / "synthetic"))
+    kw = dict(num_images=3, image_size=(128, 160), max_objects=2)
+    imdb, roidb = load_gt_roidb(cfg, training=False, **kw)
+    loader = TestLoader(roidb, cfg)
+    model = build_model(cfg)
+    b = next(iter(loader))[0]
+    variables = jax.jit(model.init)(
+        jax.random.PRNGKey(0), jnp.asarray(b.images),
+        jnp.asarray(b.im_info))
+    props = generate_proposals(model, variables, loader, cfg)
+    assert len(props) == len(roidb)
+    for p in props:
+        assert p.ndim == 2 and p.shape[1] == 5
+        if len(p) > 1:  # scores are descending (ref pkl ordering)
+            assert (np.diff(p[:, 4]) <= 1e-6).all()
+        # boxes are in raw image coordinates
+        assert (p[:, 2] <= 160.0).all() and (p[:, 3] <= 128.0).all()

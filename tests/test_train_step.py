@@ -117,6 +117,39 @@ def test_frozen_mask_prefixes():
     assert mask["rpn"]["rpn_conv_3x3"]["kernel"] is True
 
 
+def test_frozen_mask_bn_affine_network_wide():
+    """Ref ResNet FIXED_PARAMS lists 'gamma'/'beta': EVERY BatchNorm affine
+    is frozen (ADVICE r1 medium), including unfrozen stages and the head —
+    but not conv kernels there, and not non-BN biases."""
+    cfg = generate_config("resnet101", "PascalVOC")
+    fake_params = {
+        "backbone": {
+            "stage3_unit5": {
+                "bn1": {"scale": jnp.zeros(1), "bias": jnp.zeros(1)},
+                "conv1": {"kernel": jnp.zeros(1)},
+            },
+        },
+        "head": {
+            "stage4_unit1": {"bn2": {"scale": jnp.zeros(1)}},
+            "bn1": {"scale": jnp.zeros(1), "bias": jnp.zeros(1)},
+        },
+        "cls_score": {"kernel": jnp.zeros(1), "bias": jnp.zeros(1)},
+    }
+    mask = frozen_mask(fake_params, cfg.network.fixed_params)
+    assert mask["backbone"]["stage3_unit5"]["bn1"]["scale"] is False
+    assert mask["backbone"]["stage3_unit5"]["bn1"]["bias"] is False
+    assert mask["backbone"]["stage3_unit5"]["conv1"]["kernel"] is True
+    assert mask["head"]["stage4_unit1"]["bn2"]["scale"] is False
+    assert mask["head"]["bn1"]["scale"] is False
+    # dense bias is NOT a BN beta
+    assert mask["cls_score"]["bias"] is True
+    assert mask["cls_score"]["kernel"] is True
+    # shared-stage freezing must leave stage4 trainable (ADVICE r1 low)
+    shared = frozen_mask(fake_params, cfg.network.fixed_params_shared)
+    assert shared["head"]["stage4_unit1"]["bn2"]["scale"] is False  # BN affine
+    assert shared["backbone"]["stage3_unit5"]["conv1"]["kernel"] is False
+
+
 def test_overfit_single_batch():
     """~40 SGD steps on one synthetic image must drive the losses down and
     the accuracies up — the smoke signal that gradients flow end-to-end."""
