@@ -224,7 +224,10 @@ _NETWORKS: Mapping[str, Mapping[str, Any]] = {
         # 32/64/128-px anchors: cover both the 128-px unit-test canvases and
         # the synthetic dataset's 320x400 canvases (objects span 1/5..1/2 of
         # the canvas in data/synthetic.py)
-        anchor_scales=(2, 4, 8), fixed_params=(), fixed_params_shared=(),
+        anchor_scales=(2, 4, 8), fixed_params=(),
+        # tiny's whole backbone is conv1+conv2 — the alternate-training
+        # shared-conv freeze must cover it for the combine to be valid
+        fixed_params_shared=("conv1", "conv2"),
         compute_dtype="float32",
     ),
 }

@@ -86,12 +86,14 @@ def fit(
     prefix: Optional[str] = None,
     frequent: Optional[int] = None,
     mesh=None,
+    mode: str = "e2e",
     epoch_end_callback: Optional[Callable[[int, TrainState], None]] = None,
 ) -> TrainState:
     """Run ``begin_epoch .. num_epochs`` epochs; checkpoint per epoch.
 
     ``mesh``: a 1-D ``jax.sharding.Mesh`` enables data-parallel SPMD (the
     kvstore='device' replacement); None = single-device jit.
+    ``mode``: 'e2e' | 'rpn' | 'rcnn' (alternate-training stages).
     ``key`` is the base RNG; the step folds in ``state.step`` so resuming
     from a checkpoint replays the identical sample stream.
     """
@@ -100,13 +102,14 @@ def fit(
         from mx_rcnn_tpu.parallel.dp import (
             make_dp_train_step, replicate, shard_batch)
 
-        step_fn = make_dp_train_step(model, cfg, tx, mesh)
+        step_fn = make_dp_train_step(model, cfg, tx, mesh, mode=mode)
         state = replicate(state, mesh)
 
         def run_step(state, batch: Batch):
             return step_fn(state, shard_batch(batch, mesh), key)
     else:
-        base = jax.jit(make_train_step(model, cfg, tx), donate_argnums=(0,))
+        base = jax.jit(make_train_step(model, cfg, tx, mode=mode),
+                       donate_argnums=(0,))
 
         def run_step(state, batch: Batch):
             return base(state, batch, key)

@@ -69,26 +69,33 @@ class Batch(NamedTuple):
     gt_valid: jnp.ndarray
 
 
-def loss_and_metrics(
-    model: FasterRCNN,
-    params,
-    batch_stats,
-    batch: Batch,
-    key: jax.Array,
-    cfg: Config,
-) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
-    """Full train-mode forward; returns (total_loss, metrics)."""
+class RCNNBatch(NamedTuple):
+    """Batch for RCNN-only training from PRECOMPUTED proposals (alternate
+    training stages 2/4; ref ``ROIIter`` feeds ``get_rcnn_batch``).
+
+    Same fields as :class:`Batch` plus the proposal buffer:
+    rois: (N, R, 4) proposal boxes in input (scaled) coordinates.
+    rois_valid: (N, R) bool.
+    """
+
+    images: jnp.ndarray
+    im_info: jnp.ndarray
+    gt_boxes: jnp.ndarray
+    gt_classes: jnp.ndarray
+    gt_valid: jnp.ndarray
+    rois: jnp.ndarray
+    rois_valid: jnp.ndarray
+
+
+def _rpn_losses(model: FasterRCNN, rpn_cls, rpn_box, anchors, batch,
+                key: jax.Array, cfg: Config):
+    """Anchor targets + the two RPN losses (shared by e2e and RPN-only
+    training so the objectives cannot drift apart).
+
+    Returns (cls_loss, bbox_loss, metrics dict).
+    """
     tr = cfg.train
-    variables = {"params": params, "batch_stats": batch_stats}
     n = batch.images.shape[0]
-    k_anchor, k_prop, k_drop = jax.random.split(key, 3)
-
-    feat = model.apply(variables, batch.images, method=model.features)
-    rpn_cls, rpn_box = model.apply(variables, feat, method=model.rpn_raw)
-    _, fh, fw, _ = feat.shape
-    anchors = model.anchors_for(fh, fw)
-
-    # ---- RPN targets (in-graph; ref host-side assign_anchor) --------------
     at = jax.vmap(
         functools.partial(
             anchor_target,
@@ -102,29 +109,38 @@ def loss_and_metrics(
         ),
         in_axes=(None, 0, 0, 0, 0),
     )(anchors, batch.gt_boxes, batch.gt_valid, batch.im_info,
-      jax.random.split(k_anchor, n))
+      jax.random.split(key, n))
 
     rpn_cls32 = rpn_cls.astype(jnp.float32)
-    rpn_cls_loss = softmax_cross_entropy_with_ignore(
+    cls_loss = softmax_cross_entropy_with_ignore(
         rpn_cls32.reshape(-1, 2), at.labels.reshape(-1), -1, "valid")
-    rpn_bbox_loss = weighted_smooth_l1(
+    bbox_loss = weighted_smooth_l1(
         rpn_box.astype(jnp.float32), at.bbox_targets, at.bbox_weights,
         sigma=3.0, grad_norm=tr.rpn_batch_size * n)
+    metrics = {
+        "rpn_acc": accuracy_with_ignore(rpn_cls32.reshape(-1, 2),
+                                        at.labels.reshape(-1)),
+        "rpn_logloss": cls_loss,
+        "rpn_l1loss": bbox_loss,
+    }
+    return cls_loss, bbox_loss, metrics
 
-    # ---- proposals + ROI sampling (no gradient; ref Proposal/proposal_target
-    # CustomOps define no backward) ----------------------------------------
-    fg_scores = jax.nn.softmax(jax.lax.stop_gradient(rpn_cls32), axis=-1)[..., 1]
-    rpn_box_sg = jax.lax.stop_gradient(rpn_box.astype(jnp.float32))
 
-    def one_img(scores_i, box_i, info_i, gt_b, gt_c, gt_v, key_i):
-        rois, _, roi_valid = propose(
-            scores_i, box_i, anchors, info_i,
-            pre_nms_top_n=tr.rpn_pre_nms_top_n,
-            post_nms_top_n=tr.rpn_post_nms_top_n,
-            nms_thresh=tr.rpn_nms_thresh,
-            min_size=tr.rpn_min_size)
+def _rcnn_losses(model: FasterRCNN, variables, feat, rois, rois_valid,
+                 batch, key: jax.Array, cfg: Config):
+    """ROI sampling + pooled head + the two RCNN losses (shared by e2e and
+    RCNN-only training).  ``rois`` come either from the in-graph proposal
+    op (e2e) or from a precomputed buffer (alternate stages 2/4).
+
+    Returns (cls_loss, bbox_loss, metrics dict).
+    """
+    tr = cfg.train
+    n = batch.images.shape[0]
+    k_prop, k_drop = jax.random.split(key)
+
+    def one_img(rois_i, valid_i, gt_b, gt_c, gt_v, key_i):
         return proposal_target(
-            rois, roi_valid, gt_b, gt_c, gt_v, key_i,
+            rois_i, valid_i, gt_b, gt_c, gt_v, key_i,
             num_classes=model.num_classes,
             batch_rois=tr.batch_rois,
             fg_fraction=tr.fg_fraction,
@@ -136,10 +152,9 @@ def loss_and_metrics(
             gt_append=tr.gt_append)
 
     pt = jax.vmap(one_img)(
-        fg_scores, rpn_box_sg, batch.im_info, batch.gt_boxes,
-        batch.gt_classes, batch.gt_valid, jax.random.split(k_prop, n))
+        rois, rois_valid, batch.gt_boxes, batch.gt_classes, batch.gt_valid,
+        jax.random.split(k_prop, n))
 
-    # ---- RCNN head on pooled ROI features ---------------------------------
     pooled = jax.vmap(
         lambda f, r: roi_align(f, r, model.pooled_size, 1.0 / model.feat_stride)
     )(feat, pt.rois)  # (N, B, ph, pw, C)
@@ -155,28 +170,115 @@ def loss_and_metrics(
     # filler ROIs (sample_rois fills all BATCH_ROIS slots), so its batch
     # denominator always equals the valid count; 'valid' is the faithful
     # generalization when the proposal pool is too small to fill every slot
-    rcnn_cls_loss = softmax_cross_entropy_with_ignore(
+    cls_loss = softmax_cross_entropy_with_ignore(
         cls_logits, labels, -1, "valid")
-    rcnn_bbox_loss = weighted_smooth_l1(
+    bbox_loss = weighted_smooth_l1(
         bbox_deltas, pt.bbox_targets.reshape(bbox_deltas.shape),
         pt.bbox_weights.reshape(bbox_deltas.shape),
         sigma=1.0, grad_norm=tr.batch_rois * n)
-
-    total = rpn_cls_loss + rpn_bbox_loss + rcnn_cls_loss + rcnn_bbox_loss
-
-    # the six reference metrics (rcnn/core/metric.py)
     metrics = {
-        "rpn_acc": accuracy_with_ignore(rpn_cls32.reshape(-1, 2),
-                                        at.labels.reshape(-1)),
-        "rpn_logloss": rpn_cls_loss,
-        "rpn_l1loss": rpn_bbox_loss,
         "rcnn_acc": accuracy_with_ignore(cls_logits, labels),
-        "rcnn_logloss": rcnn_cls_loss,
-        "rcnn_l1loss": rcnn_bbox_loss,
-        "loss": total,
+        "rcnn_logloss": cls_loss,
+        "rcnn_l1loss": bbox_loss,
         "num_fg": pt.fg_mask.sum().astype(jnp.float32),
     }
+    return cls_loss, bbox_loss, metrics
+
+
+def loss_and_metrics(
+    model: FasterRCNN,
+    params,
+    batch_stats,
+    batch: Batch,
+    key: jax.Array,
+    cfg: Config,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Full train-mode forward; returns (total_loss, metrics)."""
+    tr = cfg.train
+    variables = {"params": params, "batch_stats": batch_stats}
+    k_anchor, k_rcnn = jax.random.split(key)
+
+    feat = model.apply(variables, batch.images, method=model.features)
+    rpn_cls, rpn_box = model.apply(variables, feat, method=model.rpn_raw)
+    _, fh, fw, _ = feat.shape
+    anchors = model.anchors_for(fh, fw)
+
+    rpn_cls_loss, rpn_bbox_loss, rpn_metrics = _rpn_losses(
+        model, rpn_cls, rpn_box, anchors, batch, k_anchor, cfg)
+
+    # ---- proposals (no gradient; ref Proposal/proposal_target CustomOps
+    # define no backward) ---------------------------------------------------
+    rpn_cls32 = jax.lax.stop_gradient(rpn_cls.astype(jnp.float32))
+    fg_scores = jax.nn.softmax(rpn_cls32, axis=-1)[..., 1]
+    rpn_box_sg = jax.lax.stop_gradient(rpn_box.astype(jnp.float32))
+
+    def one_img(scores_i, box_i, info_i):
+        rois, _, roi_valid = propose(
+            scores_i, box_i, anchors, info_i,
+            pre_nms_top_n=tr.rpn_pre_nms_top_n,
+            post_nms_top_n=tr.rpn_post_nms_top_n,
+            nms_thresh=tr.rpn_nms_thresh,
+            min_size=tr.rpn_min_size)
+        return rois, roi_valid
+
+    rois, rois_valid = jax.vmap(one_img)(fg_scores, rpn_box_sg,
+                                         batch.im_info)
+    rcnn_cls_loss, rcnn_bbox_loss, rcnn_metrics = _rcnn_losses(
+        model, variables, feat, rois, rois_valid, batch, k_rcnn, cfg)
+
+    total = rpn_cls_loss + rpn_bbox_loss + rcnn_cls_loss + rcnn_bbox_loss
+    # the six reference metrics (rcnn/core/metric.py)
+    metrics = {**rpn_metrics, **rcnn_metrics, "loss": total}
     return total, metrics
+
+
+def loss_and_metrics_rpn(
+    model: FasterRCNN,
+    params,
+    batch_stats,
+    batch: Batch,
+    key: jax.Array,
+    cfg: Config,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """RPN-only training loss (alternate stages 1/3; ref ``get_vgg_rpn`` /
+    ``train_rpn.py``): backbone → RPN heads → anchor targets → two losses.
+    Shares ``_rpn_losses`` with the e2e objective."""
+    variables = {"params": params, "batch_stats": batch_stats}
+    feat = model.apply(variables, batch.images, method=model.features)
+    rpn_cls, rpn_box = model.apply(variables, feat, method=model.rpn_raw)
+    _, fh, fw, _ = feat.shape
+    anchors = model.anchors_for(fh, fw)
+    cls_loss, bbox_loss, metrics = _rpn_losses(
+        model, rpn_cls, rpn_box, anchors, batch, key, cfg)
+    total = cls_loss + bbox_loss
+    return total, {**metrics, "loss": total}
+
+
+def loss_and_metrics_rcnn(
+    model: FasterRCNN,
+    params,
+    batch_stats,
+    batch: RCNNBatch,
+    key: jax.Array,
+    cfg: Config,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """RCNN-only training loss from precomputed proposals (alternate stages
+    2/4; ref ``train_rcnn.py`` + host-side ``sample_rois``).  Shares
+    ``_rcnn_losses`` with the e2e objective."""
+    variables = {"params": params, "batch_stats": batch_stats}
+    feat = model.apply(variables, batch.images, method=model.features)
+    cls_loss, bbox_loss, metrics = _rcnn_losses(
+        model, variables, feat, batch.rois, batch.rois_valid, batch, key,
+        cfg)
+    total = cls_loss + bbox_loss
+    return total, {**metrics, "loss": total}
+
+
+LOSS_FNS = {
+    "e2e": loss_and_metrics,
+    "rpn": loss_and_metrics_rpn,
+    "rcnn": loss_and_metrics_rcnn,
+}
 
 
 def init_variables(
@@ -254,19 +356,26 @@ def setup_training(
 
 
 def make_train_step(model: FasterRCNN, cfg: Config,
-                    tx: optax.GradientTransformation, axis_name: str | None = None):
+                    tx: optax.GradientTransformation,
+                    axis_name: str | None = None, mode: str = "e2e"):
     """Build the jittable train step.  When ``axis_name`` is set the step is
     meant to run under shard_map/pmap-style SPMD and gradients/metrics are
     psum-averaged over that mesh axis (the TPU replacement for MXNet
-    ``kvstore='device'``)."""
+    ``kvstore='device'``).
 
-    def step(state: TrainState, batch: Batch, key: jax.Array
+    ``mode`` selects the loss: 'e2e' (full Faster R-CNN), 'rpn' (alternate
+    stages 1/3, expects :class:`Batch`), 'rcnn' (stages 2/4, expects
+    :class:`RCNNBatch` with precomputed proposals).
+    """
+    loss_and_metrics_fn = LOSS_FNS[mode]
+
+    def step(state: TrainState, batch, key: jax.Array
              ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
         key = jax.random.fold_in(key, state.step)
 
         def loss_fn(params):
-            return loss_and_metrics(model, params, state.batch_stats, batch,
-                                    key, cfg)
+            return loss_and_metrics_fn(model, params, state.batch_stats,
+                                       batch, key, cfg)
 
         (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params)

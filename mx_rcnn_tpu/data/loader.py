@@ -127,6 +127,46 @@ class AnchorLoader:
             yield self._make_batch(indices, bucket)
 
 
+class ROIIter(AnchorLoader):
+    """RCNN-only training loader from precomputed proposals (ref
+    ``rcnn/core/loader.py — ROIIter`` feeding ``get_rcnn_batch``).
+
+    ``proposals[i]`` is the (k, 5) [x1 y1 x2 y2 score] array for roidb
+    record ``i`` in RAW image coordinates (as returned by
+    ``core.tester.generate_proposals``); boxes are scaled into input
+    coordinates per image and padded to ``max_rois`` slots.  Target
+    sampling itself runs on device (``ops.targets.proposal_target``),
+    mirroring the reference's host-side ``sample_rois``.
+    """
+
+    def __init__(self, roidb: Roidb, cfg: Config, proposals: Sequence,
+                 batch_images: int = None, shuffle: bool = True,
+                 seed: int = 0, max_rois: int = None):
+        super().__init__(roidb, cfg, batch_images, shuffle, seed)
+        if len(proposals) != len(self.roidb):
+            raise ValueError(
+                f"{len(proposals)} proposal sets for {len(self.roidb)} "
+                f"roidb records")
+        self.proposals = list(proposals)
+        self.max_rois = max_rois or cfg.test.proposal_post_nms_top_n
+
+    def _make_batch(self, indices: Sequence[int], bucket):
+        from mx_rcnn_tpu.core.train import RCNNBatch
+
+        base = super()._make_batch(indices, bucket)
+        n = len(indices)
+        r = self.max_rois
+        rois = np.zeros((n, r, 4), np.float32)
+        rois_valid = np.zeros((n, r), bool)
+        for j, i in enumerate(indices):
+            p = np.asarray(self.proposals[i], np.float32).reshape(-1, 5)
+            k = min(len(p), r)
+            scale = base.im_info[j, 2]
+            rois[j, :k] = p[:k, :4] * scale
+            rois_valid[j, :k] = True
+        return RCNNBatch(*base, rois=rois, rois_valid=rois_valid)
+
+
 class TestLoader:
     """Evaluation loader (ref ``TestLoader``): yields
     ``(Batch, indices, scales)`` — gt fields are zero-filled, ``indices``
@@ -167,8 +207,12 @@ class TestLoader:
                 scales = np.zeros((n,), np.float32)
                 for j, i in enumerate(chunk):
                     rec = self.roidb[i]
+                    # honor the flipped flag: eval roidbs never set it, but
+                    # alternate training generates proposals over the
+                    # flip-augmented TRAIN roidb through this loader
                     img, im_scale = load_and_transform(
-                        rec["image"], False, cfg.network.pixel_means,
+                        rec["image"], rec.get("flipped", False),
+                        cfg.network.pixel_means,
                         cfg.bucket.scale, cfg.bucket.max_size, bucket)
                     images[j] = img
                     im_info[j] = (round(rec["height"] * im_scale),

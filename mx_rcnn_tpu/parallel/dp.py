@@ -53,14 +53,15 @@ def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 
-def make_dp_train_step(model: FasterRCNN, cfg: Config, tx, mesh: Mesh):
+def make_dp_train_step(model: FasterRCNN, cfg: Config, tx, mesh: Mesh,
+                       mode: str = "e2e"):
     """Jitted SPMD train step over ``mesh``.
 
     Takes (replicated state, sharded batch, replicated key); returns
     (replicated state, replicated metrics).  Gradient sync is the
     ``lax.pmean('data')`` inside ``core.train.make_train_step``.
     """
-    base = make_train_step(model, cfg, tx, axis_name="data")
+    base = make_train_step(model, cfg, tx, axis_name="data", mode=mode)
 
     def shard_fn(state: TrainState, batch: Batch, key: jax.Array):
         # decorrelate per-image sampling RNG across mesh positions

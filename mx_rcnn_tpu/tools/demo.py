@@ -1,0 +1,139 @@
+"""Single-image inference demo with box visualization.
+
+Reference: ``demo.py`` + ``rcnn/core/tester.py — vis_all_detection``
+(SURVEY.md §3.4): load an image, run the test-mode forward, draw the
+per-class detections above a score threshold.
+
+Matplotlib-free: boxes and labels are drawn with PIL so the tool runs
+headless; output is a written PNG/JPEG.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+from typing import Dict, List
+
+import numpy as np
+
+from mx_rcnn_tpu.config import Config, generate_config
+from mx_rcnn_tpu.core.tester import Predictor
+from mx_rcnn_tpu.data.image import imread_rgb, resize_to_bucket
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.utils.checkpoint import load_param
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+
+def detect_image(predictor: Predictor, img: np.ndarray, cfg: Config,
+                 vis_thresh: float = 0.5) -> Dict[int, np.ndarray]:
+    """Run detection on one RGB uint8 image; returns
+    {class_id: (k, 5) [x1 y1 x2 y2 score]} in raw image coordinates.
+
+    Reuses the eval path's jitted ``_postprocess_batch`` (decode + clip +
+    unscale + per-class masked NMS) so demo and eval can never disagree on
+    postprocess semantics."""
+    import jax.numpy as jnp
+
+    from mx_rcnn_tpu.core.tester import _postprocess_batch
+
+    data, im_scale, bucket = resize_to_bucket(
+        img, cfg.network.pixel_means, cfg.bucket.scale, cfg.bucket.max_size,
+        tuple(tuple(s) for s in cfg.bucket.shapes))
+    h, w = img.shape[:2]
+    im_info = np.array([[round(h * im_scale), round(w * im_scale),
+                         im_scale]], np.float32)
+    rois, roi_valid, cls_prob, deltas = predictor.raw(data[None], im_info)
+    num_classes = cls_prob.shape[-1]
+    stds = jnp.tile(jnp.asarray(cfg.train.bbox_stds, jnp.float32),
+                    num_classes)
+    means = jnp.tile(jnp.asarray(cfg.train.bbox_means, jnp.float32),
+                     num_classes)
+    boxes_b, scores_b, keep_b = map(np.asarray, _postprocess_batch(
+        rois, roi_valid, cls_prob, deltas, jnp.asarray(im_info),
+        jnp.asarray([im_scale], dtype=jnp.float32), stds, means,
+        nms_thresh=cfg.test.nms, score_thresh=vis_thresh))
+    r = boxes_b.shape[1]
+    boxes = boxes_b[0].reshape(r, num_classes, 4)
+    out: Dict[int, np.ndarray] = {}
+    for c in range(1, num_classes):
+        keep = keep_b[0, c]
+        if keep.any():
+            out[c] = np.hstack([boxes[keep, c],
+                                scores_b[0][keep, c, None]]
+                               ).astype(np.float32)
+    return out
+
+
+_COLORS = [(230, 60, 60), (60, 200, 80), (70, 110, 240), (240, 200, 50),
+           (200, 70, 220), (70, 210, 210), (250, 140, 50), (150, 150, 150)]
+
+
+def draw_detections(img: np.ndarray, dets: Dict[int, np.ndarray],
+                    class_names: List[str] = None) -> np.ndarray:
+    """Draw labelled boxes (ref ``vis_all_detection``, PIL instead of
+    matplotlib); returns an annotated RGB uint8 array."""
+    from PIL import Image, ImageDraw
+
+    im = Image.fromarray(img.astype(np.uint8))
+    draw = ImageDraw.Draw(im)
+    for c, arr in sorted(dets.items()):
+        color = _COLORS[c % len(_COLORS)]
+        name = class_names[c] if class_names and c < len(class_names) \
+            else f"cls{c}"
+        for x1, y1, x2, y2, score in arr:
+            draw.rectangle([float(x1), float(y1), float(x2), float(y2)],
+                           outline=color, width=2)
+            draw.text((float(x1) + 2, float(y1) + 2),
+                      f"{name} {score:.2f}", fill=color)
+    return np.asarray(im)
+
+
+def demo(cfg: Config, *, prefix: str, epoch: int, image: str,
+         out_path: str, vis_thresh: float = 0.5,
+         class_names: List[str] = None) -> Dict[int, np.ndarray]:
+    model = build_model(cfg)
+    params, batch_stats = load_param(prefix, epoch)
+    predictor = Predictor(
+        model, {"params": params, "batch_stats": batch_stats}, cfg)
+    img = imread_rgb(image)
+    dets = detect_image(predictor, img, cfg, vis_thresh)
+    n = sum(len(v) for v in dets.values())
+    logger.info("%d detections over %.2f in %s", n, vis_thresh, image)
+    annotated = draw_detections(img, dets, class_names)
+    from PIL import Image
+
+    Image.fromarray(annotated).save(out_path)
+    logger.info('wrote annotated image to "%s"', out_path)
+    return dets
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        description="Single-image detection demo (ref demo.py)")
+    p.add_argument("--network", default="resnet101",
+                   choices=["vgg", "resnet50", "resnet101", "tiny"])
+    p.add_argument("--dataset", default="PascalVOC",
+                   choices=["PascalVOC", "coco", "synthetic"])
+    p.add_argument("--prefix", default="model/e2e")
+    p.add_argument("--epoch", type=int, required=True)
+    p.add_argument("--image", required=True)
+    p.add_argument("--out", default=None,
+                   help="output path (default: <image>_det.png)")
+    p.add_argument("--vis_thresh", type=float, default=0.5)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    args = parse_args(argv)
+    cfg = generate_config(args.network, args.dataset)
+    out = args.out or (os.path.splitext(args.image)[0] + "_det.png")
+    demo(cfg, prefix=args.prefix, epoch=args.epoch, image=args.image,
+         out_path=out, vis_thresh=args.vis_thresh)
+
+
+if __name__ == "__main__":
+    main()

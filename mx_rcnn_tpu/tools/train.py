@@ -33,21 +33,36 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
               num_devices: int = 1, frequent: int = None, seed: int = 0,
               pretrained: str = None, pretrained_epoch: int = 0,
               roidb=None, dataset_kw: dict = None,
-              frozen_prefixes=None):
-    """Train end-to-end; returns the final TrainState.
+              frozen_prefixes=None, mode: str = "e2e", proposals=None,
+              init_from=None):
+    """Train; returns the final TrainState.
 
-    ``roidb`` may be injected (the alternate-training driver does); when
-    None it is loaded from ``cfg.dataset``.
+    ``mode``: 'e2e' | 'rpn' | 'rcnn' — the alternate-training stage drivers
+    reuse this function (ref ``rcnn/tools/train_rpn.py``/``train_rcnn.py``
+    are thin variations of ``train_net`` the same way).
+    ``proposals``: per-roidb-record proposal arrays (required for 'rcnn').
+    ``init_from``: (prefix, epoch) checkpoint to initialize params and
+    batch_stats from (stage chaining; optimizer state starts fresh).
+    ``roidb`` may be injected (the alternate driver does); when None it is
+    loaded from ``cfg.dataset``.
     """
     if end_epoch is None:
         end_epoch = cfg.default.e2e_epoch
     if roidb is None:
         _, roidb = load_gt_roidb(cfg, training=True, **(dataset_kw or {}))
-    logger.info("training on %d roidb images", len(roidb))
+    logger.info("[%s] training on %d roidb images", mode, len(roidb))
 
     n_total = cfg.train.batch_images * num_devices
-    loader = AnchorLoader(roidb, cfg, batch_images=n_total,
-                          shuffle=cfg.train.shuffle, seed=seed)
+    if mode == "rcnn":
+        from mx_rcnn_tpu.data.loader import ROIIter
+
+        if proposals is None:
+            raise ValueError("mode='rcnn' requires precomputed proposals")
+        loader = ROIIter(roidb, cfg, proposals, batch_images=n_total,
+                         shuffle=cfg.train.shuffle, seed=seed)
+    else:
+        loader = AnchorLoader(roidb, cfg, batch_images=n_total,
+                              shuffle=cfg.train.shuffle, seed=seed)
     steps_per_epoch = max(len(loader), 1)
     logger.info("%d batches/epoch (global batch %d)", steps_per_epoch,
                 n_total)
@@ -65,6 +80,12 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
 
         state = load_pretrained_into(state, pretrained, pretrained_epoch, cfg)
         logger.info("grafted pretrained backbone from %s", pretrained)
+    if init_from is not None:
+        from mx_rcnn_tpu.utils.checkpoint import load_param
+
+        p, s = load_param(*init_from)
+        state = state._replace(params=p, batch_stats=s)
+        logger.info("initialized params from %s epoch %d", *init_from)
     if begin_epoch > 0:
         state = restore_state(state, prefix, begin_epoch)
         logger.info("resumed from %s epoch %d", prefix, begin_epoch)
@@ -76,7 +97,7 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
         mesh = device_mesh(num_devices)
     state = fit(model, cfg, state, tx, loader, end_epoch, key,
                 begin_epoch=begin_epoch, prefix=prefix, frequent=frequent,
-                mesh=mesh)
+                mesh=mesh, mode=mode)
     return state
 
 

@@ -1,0 +1,57 @@
+"""Demo tool tests (VERDICT r1 item 9): detect_image + draw_detections +
+the end-to-end demo() path writing an annotated image."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.tools.demo import demo, draw_detections
+
+
+def test_draw_detections_marks_pixels():
+    img = np.zeros((60, 80, 3), np.uint8)
+    dets = {1: np.array([[10, 10, 40, 30, 0.9]], np.float32)}
+    out = draw_detections(img, dets, ["bg", "thing"])
+    assert out.shape == img.shape
+    assert out.sum() > 0  # something was drawn
+    # box outline touches the expected rows/cols
+    assert out[10, 10:41].sum() > 0
+
+
+def test_demo_end_to_end(tmp_path):
+    """Train-free demo run: random-init tiny model on a synthetic image —
+    must produce a valid annotated file regardless of detection count."""
+    import jax
+
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.core.train import setup_training
+    from mx_rcnn_tpu.data import get_dataset
+    from mx_rcnn_tpu.models import build_model
+    from mx_rcnn_tpu.utils.checkpoint import save_checkpoint
+
+    cfg = generate_config("tiny", "synthetic",
+                          dataset__root_path=str(tmp_path),
+                          dataset__dataset_path=str(tmp_path / "synthetic"),
+                          dataset__num_classes=4)
+    cfg = cfg.replace_in("test", rpn_pre_nms_top_n=256, rpn_post_nms_top_n=32)
+    cfg = cfg.replace_in("bucket", scale=128, max_size=160,
+                         shapes=((128, 160), (160, 128)))
+    ds = get_dataset("synthetic", "demo", str(tmp_path),
+                     str(tmp_path / "synthetic"), num_images=1,
+                     num_classes=4, image_size=(128, 160))
+    roidb = ds.gt_roidb()
+    model = build_model(cfg)
+    state, _ = setup_training(model, cfg, jax.random.PRNGKey(0),
+                              (1, 128, 160, 3), steps_per_epoch=1)
+    prefix = str(tmp_path / "m")
+    save_checkpoint(prefix, 1, state)
+    out_path = str(tmp_path / "annotated.png")
+    dets = demo(cfg, prefix=prefix, epoch=1, image=roidb[0]["image"],
+                out_path=out_path, vis_thresh=0.05)
+    assert os.path.exists(out_path)
+    from PIL import Image
+
+    with Image.open(out_path) as im:
+        assert im.size == (160, 128)
+    assert isinstance(dets, dict)
