@@ -1,0 +1,29 @@
+# Build driver (reference parity: the mx-rcnn top-level Makefile that
+# compiles rcnn/cython and rcnn/pycocotools extensions).
+#
+# Here the only ahead-of-time native artifact is the host-side C++ kernel
+# library (NMS/IoU + RLE mask ops); the device kernels are XLA/jnp and need
+# no build step.  The library also builds itself on first import, so `make`
+# is optional — it exists for parity and for building without importing.
+
+CXX ?= g++
+CXXFLAGS ?= -O3 -shared -fPIC -std=c++17
+
+NATIVE_DIR := mx_rcnn_tpu/native
+NATIVE_LIB := $(NATIVE_DIR)/libmxrcnn_native.so
+NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
+
+.PHONY: all native test clean
+
+all: native
+
+native: $(NATIVE_LIB)
+
+$(NATIVE_LIB): $(NATIVE_SRC)
+	$(CXX) $(CXXFLAGS) -o $@ $(NATIVE_SRC)
+
+test:
+	python -m pytest tests/ -x -q
+
+clean:
+	rm -f $(NATIVE_LIB)

@@ -18,7 +18,9 @@ TPU-native differences:
 
 from __future__ import annotations
 
-from typing import Iterator, List, Sequence, Tuple
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, Iterator, List, Sequence, Tuple
 
 import numpy as np
 
@@ -27,6 +29,44 @@ from mx_rcnn_tpu.core.train import Batch
 from mx_rcnn_tpu.data.image import (choose_bucket, compute_scale,
                                     load_and_transform)
 from mx_rcnn_tpu.data.roidb import Roidb
+
+
+def _prefetched(work: Iterable, make: Callable, num_workers: int,
+                prefetch: int) -> Iterator:
+    """Run ``make(item)`` on a thread pool, keeping up to ``prefetch``
+    results in flight; yield results in submission order.
+
+    The reference loader is synchronous (SURVEY.md §3.1 — "no multiprocess
+    prefetch"); feeding a ~30 imgs/s TPU chip from single-threaded cv2 would
+    starve it, so batch assembly (imdecode + resize + pad — all
+    GIL-releasing cv2/numpy) overlaps with device steps.  Thread pool, not
+    processes: the arrays are large and fork/pickle would cost more than
+    the GIL does.  num_workers=0 degrades to the synchronous path.
+    """
+    if num_workers <= 0:
+        for item in work:
+            yield make(item)
+        return
+    ex = ThreadPoolExecutor(num_workers)
+    futures: deque = deque()
+    it = iter(work)
+    exhausted = False
+    try:
+        while True:
+            while not exhausted and len(futures) < max(prefetch, 1):
+                try:  # guard ONLY the source iterator: a StopIteration
+                    item = next(it)  # escaping from a worker must propagate
+                except StopIteration:
+                    exhausted = True
+                    break
+                futures.append(ex.submit(make, item))
+            if not futures:
+                break
+            yield futures.popleft().result()
+    finally:
+        # early abandonment (consumer break / error): drop queued work and
+        # return without waiting on in-flight batch builds
+        ex.shutdown(wait=False, cancel_futures=True)
 
 
 def _bucket_of(rec, buckets, scale, max_size) -> Tuple[int, int]:
@@ -45,12 +85,17 @@ class AnchorLoader:
     """
 
     def __init__(self, roidb: Roidb, cfg: Config, batch_images: int = None,
-                 shuffle: bool = True, seed: int = 0):
+                 shuffle: bool = True, seed: int = 0,
+                 num_workers: int = None, prefetch: int = None):
         self.roidb = list(roidb)
         self.cfg = cfg
         self.batch_images = batch_images or cfg.train.batch_images
         self.shuffle = shuffle
         self.seed = seed
+        self.num_workers = (cfg.default.num_workers if num_workers is None
+                            else num_workers)
+        self.prefetch = (cfg.default.prefetch if prefetch is None
+                         else prefetch)
         self._epoch = 0
         b = cfg.bucket
         self.buckets = tuple(tuple(s) for s in b.shapes)
@@ -123,8 +168,9 @@ class AnchorLoader:
                 batches.append((bucket, idx[s:s + self.batch_images]))
         if self.shuffle:
             rng.shuffle(batches)
-        for bucket, indices in batches:
-            yield self._make_batch(indices, bucket)
+        yield from _prefetched(
+            batches, lambda b: self._make_batch(b[1], b[0]),
+            self.num_workers, self.prefetch)
 
 
 class ROIIter(AnchorLoader):
@@ -141,8 +187,10 @@ class ROIIter(AnchorLoader):
 
     def __init__(self, roidb: Roidb, cfg: Config, proposals: Sequence,
                  batch_images: int = None, shuffle: bool = True,
-                 seed: int = 0, max_rois: int = None):
-        super().__init__(roidb, cfg, batch_images, shuffle, seed)
+                 seed: int = 0, max_rois: int = None,
+                 num_workers: int = None, prefetch: int = None):
+        super().__init__(roidb, cfg, batch_images, shuffle, seed,
+                         num_workers=num_workers, prefetch=prefetch)
         if len(proposals) != len(self.roidb):
             raise ValueError(
                 f"{len(proposals)} proposal sets for {len(self.roidb)} "
@@ -173,10 +221,15 @@ class TestLoader:
     are roidb positions and ``scales`` un-map detections back to raw image
     coordinates (ref pred_eval divides boxes by im_scale)."""
 
-    def __init__(self, roidb: Roidb, cfg: Config, batch_images: int = None):
+    def __init__(self, roidb: Roidb, cfg: Config, batch_images: int = None,
+                 num_workers: int = None, prefetch: int = None):
         self.roidb = list(roidb)
         self.cfg = cfg
         self.batch_images = batch_images or cfg.test.batch_images
+        self.num_workers = (cfg.default.num_workers if num_workers is None
+                            else num_workers)
+        self.prefetch = (cfg.default.prefetch if prefetch is None
+                         else prefetch)
         b = cfg.bucket
         self.buckets = tuple(tuple(s) for s in b.shapes)
         self._bucket_ids = [
@@ -194,35 +247,41 @@ class TestLoader:
             for bucket in set(self._bucket_ids)
         )
 
-    def __iter__(self):
+    def _make_batch(self, chunk: Sequence[int], bucket):
         cfg = self.cfg
+        n = len(chunk)
+        bh, bw = bucket
+        images = np.zeros((n, bh, bw, 3), np.float32)
+        im_info = np.zeros((n, 3), np.float32)
+        scales = np.zeros((n,), np.float32)
+        for j, i in enumerate(chunk):
+            rec = self.roidb[i]
+            # honor the flipped flag: eval roidbs never set it, but
+            # alternate training generates proposals over the
+            # flip-augmented TRAIN roidb through this loader
+            img, im_scale = load_and_transform(
+                rec["image"], rec.get("flipped", False),
+                cfg.network.pixel_means,
+                cfg.bucket.scale, cfg.bucket.max_size, bucket)
+            images[j] = img
+            im_info[j] = (round(rec["height"] * im_scale),
+                          round(rec["width"] * im_scale), im_scale)
+            scales[j] = im_scale
+        g = cfg.train.max_gt_boxes
+        batch = Batch(
+            images, im_info,
+            np.zeros((n, g, 4), np.float32),
+            np.zeros((n, g), np.int32),
+            np.zeros((n, g), bool),
+        )
+        return batch, list(chunk), scales
+
+    def __iter__(self):
+        batches = []
         for bucket in sorted(set(self._bucket_ids)):
             idx = [i for i, b in enumerate(self._bucket_ids) if b == bucket]
             for s in range(0, len(idx), self.batch_images):
-                chunk = idx[s:s + self.batch_images]
-                n = len(chunk)
-                bh, bw = bucket
-                images = np.zeros((n, bh, bw, 3), np.float32)
-                im_info = np.zeros((n, 3), np.float32)
-                scales = np.zeros((n,), np.float32)
-                for j, i in enumerate(chunk):
-                    rec = self.roidb[i]
-                    # honor the flipped flag: eval roidbs never set it, but
-                    # alternate training generates proposals over the
-                    # flip-augmented TRAIN roidb through this loader
-                    img, im_scale = load_and_transform(
-                        rec["image"], rec.get("flipped", False),
-                        cfg.network.pixel_means,
-                        cfg.bucket.scale, cfg.bucket.max_size, bucket)
-                    images[j] = img
-                    im_info[j] = (round(rec["height"] * im_scale),
-                                  round(rec["width"] * im_scale), im_scale)
-                    scales[j] = im_scale
-                g = cfg.train.max_gt_boxes
-                batch = Batch(
-                    images, im_info,
-                    np.zeros((n, g, 4), np.float32),
-                    np.zeros((n, g), np.int32),
-                    np.zeros((n, g), bool),
-                )
-                yield batch, chunk, scales
+                batches.append((bucket, idx[s:s + self.batch_images]))
+        yield from _prefetched(
+            batches, lambda b: self._make_batch(b[1], b[0]),
+            self.num_workers, self.prefetch)

@@ -1,0 +1,267 @@
+// Run-length-encoded binary mask operations (COCO RLE format).
+//
+// Reference: rcnn/pycocotools/maskApi.c + _mask.pyx — the C core of the
+// vendored pycocotools the reference builds for COCO annotation loading and
+// evaluation.  This is an independent C++ implementation of the same
+// on-the-wire format: masks are encoded as alternating run lengths of 0s
+// and 1s in COLUMN-MAJOR (Fortran) pixel order, starting with a (possibly
+// empty) run of 0s; the compressed string form packs counts as 5-bit
+// little-endian chunks with a continuation bit, offset by 48 into
+// printable ASCII, with counts from index 3 on stored as deltas against
+// count[i-2].
+//
+// Eval is host-side (SURVEY.md §2 native-inventory item 6): there is no TPU
+// port of these — they exist so COCO crowd-region annotations and
+// segmentation results round-trip without pycocotools installed.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// ---- encode / decode ------------------------------------------------------
+
+// mask: (h*w) uint8 in column-major order. counts_out: caller-allocated,
+// capacity h*w+1. Returns number of counts written.
+int64_t rle_encode(const uint8_t* mask, int64_t h, int64_t w,
+                   uint32_t* counts_out) {
+  const int64_t n = h * w;
+  int64_t m = 0;
+  uint8_t prev = 0;
+  uint32_t run = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t v = mask[i] ? 1 : 0;
+    if (v != prev) {
+      counts_out[m++] = run;
+      run = 0;
+      prev = v;
+    }
+    ++run;
+  }
+  counts_out[m++] = run;
+  return m;
+}
+
+// counts (m) -> mask (h*w) uint8 column-major. Returns 0 on success,
+// -1 if the counts do not sum to h*w.
+int rle_decode(const uint32_t* counts, int64_t m, int64_t h, int64_t w,
+               uint8_t* mask_out) {
+  int64_t pos = 0;
+  const int64_t n = h * w;
+  uint8_t v = 0;
+  for (int64_t i = 0; i < m; ++i) {
+    const int64_t run = counts[i];
+    if (pos + run > n) return -1;
+    std::memset(mask_out + pos, v, run);
+    pos += run;
+    v = !v;
+  }
+  return pos == n ? 0 : -1;
+}
+
+int64_t rle_area(const uint32_t* counts, int64_t m) {
+  int64_t a = 0;
+  for (int64_t i = 1; i < m; i += 2) a += counts[i];
+  return a;
+}
+
+// ---- geometry -------------------------------------------------------------
+
+// Tight bbox (x1, y1, w, h) in COCO convention (exclusive w/h) of an RLE.
+void rle_to_bbox(const uint32_t* counts, int64_t m, int64_t h, int64_t /*w*/,
+                 double* bb) {
+  int64_t xmin = INT64_MAX, xmax = -1, ymin = INT64_MAX, ymax = -1;
+  int64_t pos = 0;
+  for (int64_t i = 0; i < m; ++i) {
+    const int64_t run = counts[i];
+    if (i % 2 == 1 && run > 0) {  // a run of 1s covers pixels [pos, pos+run)
+      const int64_t first = pos, last = pos + run - 1;
+      xmin = std::min(xmin, first / h);
+      xmax = std::max(xmax, last / h);
+      // the run may span several columns; within spanned columns every row
+      // is covered, so rows only bound via the end columns
+      if (first / h == last / h) {
+        ymin = std::min(ymin, first % h);
+        ymax = std::max(ymax, last % h);
+      } else {
+        ymin = std::min(ymin, first % h);
+        ymax = std::max(ymax, last % h);
+        if (last / h - first / h >= 1) {
+          // interior columns are fully covered
+          ymin = 0;
+          ymax = h - 1;
+        }
+      }
+    }
+    pos += run;
+  }
+  if (xmax < 0) {
+    bb[0] = bb[1] = bb[2] = bb[3] = 0;
+    return;
+  }
+  bb[0] = (double)xmin;
+  bb[1] = (double)ymin;
+  bb[2] = (double)(xmax - xmin + 1);
+  bb[3] = (double)(ymax - ymin + 1);
+}
+
+// ---- run-walk set algebra -------------------------------------------------
+
+namespace {
+
+// Iterate two RLEs in lockstep, accumulating the length where both are 1.
+int64_t intersection_area(const uint32_t* a, int64_t ma, const uint32_t* b,
+                          int64_t mb) {
+  int64_t ia = 0, ib = 0;
+  int64_t ra = ia < ma ? a[0] : 0, rb = ib < mb ? b[0] : 0;
+  uint8_t va = 0, vb = 0;
+  int64_t inter = 0;
+  while (ia < ma && ib < mb) {
+    while (ra == 0 && ++ia < ma) { ra = a[ia]; va = !va; }
+    while (rb == 0 && ++ib < mb) { rb = b[ib]; vb = !vb; }
+    if (ia >= ma || ib >= mb) break;
+    const int64_t step = std::min(ra, rb);
+    if (va && vb) inter += step;
+    ra -= step;
+    rb -= step;
+  }
+  return inter;
+}
+
+}  // namespace
+
+// IoU of two RLE masks; iscrowd uses the detection area as denominator
+// (COCO crowd semantics).
+double rle_iou(const uint32_t* dt, int64_t mdt, const uint32_t* gt,
+               int64_t mgt, int iscrowd) {
+  const int64_t inter = intersection_area(dt, mdt, gt, mgt);
+  const int64_t adt = rle_area(dt, mdt);
+  const int64_t agt = rle_area(gt, mgt);
+  const double denom =
+      iscrowd ? (double)adt : (double)(adt + agt - inter);
+  return denom > 0 ? (double)inter / denom : 0.0;
+}
+
+// Merge (union or intersection) of two RLEs over the same canvas.
+// counts_out capacity h*w+1; returns count.
+int64_t rle_merge(const uint32_t* a, int64_t ma, const uint32_t* b,
+                  int64_t mb, int intersect, uint32_t* counts_out) {
+  int64_t ia = 0, ib = 0;
+  int64_t ra = ia < ma ? a[0] : 0, rb = ib < mb ? b[0] : 0;
+  uint8_t va = 0, vb = 0;
+  int64_t m = 0;
+  uint8_t cur = 0;
+  uint32_t run = 0;
+  while (true) {
+    while (ra == 0 && ia + 1 < ma) { ra = a[++ia]; va = !va; }
+    while (rb == 0 && ib + 1 < mb) { rb = b[++ib]; vb = !vb; }
+    if (ra == 0 && rb == 0) break;
+    int64_t step;
+    uint8_t v;
+    if (ra == 0) { step = rb; v = intersect ? 0 : vb; }
+    else if (rb == 0) { step = ra; v = intersect ? 0 : va; }
+    else {
+      step = std::min(ra, rb);
+      v = intersect ? (va && vb) : (va || vb);
+    }
+    if (v != cur) { counts_out[m++] = run; run = 0; cur = v; }
+    run += (uint32_t)step;
+    if (ra >= step) ra -= step;
+    if (rb >= step && !(ra == 0 && rb == 0)) rb -= step;
+  }
+  counts_out[m++] = run;
+  return m;
+}
+
+// ---- compressed-string codec ----------------------------------------------
+
+// COCO LEB-ish codec: 5-bit chunks + continuation bit, '0'+48 offset,
+// counts[i>=3] delta-coded against counts[i-2]. Output buffer capacity
+// must be >= m*7+1. Returns string length (no NUL accounting needed).
+int64_t rle_to_string(const uint32_t* counts, int64_t m, char* s) {
+  int64_t p = 0;
+  for (int64_t i = 0; i < m; ++i) {
+    long long x = (long long)counts[i];
+    if (i > 2) x -= (long long)counts[i - 2];
+    int more = 1;
+    while (more) {
+      char c = x & 0x1f;
+      x >>= 5;
+      more = (c & 0x10) ? (x != -1) : (x != 0);
+      if (more) c |= 0x20;
+      c += 48;
+      s[p++] = c;
+    }
+  }
+  s[p] = 0;
+  return p;
+}
+
+// Decode; counts_out capacity must be >= strlen(s) (each count uses >=1
+// char). Returns number of counts, or -1 on malformed input.
+int64_t rle_from_string(const char* s, int64_t slen, uint32_t* counts_out) {
+  int64_t m = 0, p = 0;
+  while (p < slen) {
+    long long x = 0;
+    int k = 0, more = 1;
+    while (more) {
+      if (p >= slen) return -1;
+      const long long c = (long long)(s[p++] - 48);
+      x |= (c & 0x1f) << (5 * k);
+      more = (int)(c & 0x20);
+      ++k;
+      if (!more && (c & 0x10)) x |= -1LL << (5 * k);
+    }
+    if (m > 2) x += (long long)counts_out[m - 2];
+    counts_out[m++] = (uint32_t)x;
+  }
+  return m;
+}
+
+// ---- polygon rasterization ------------------------------------------------
+
+// Even-odd scanline fill of a closed polygon (xy: x0,y0,x1,y1,... in
+// continuous image coordinates) onto an (h, w) canvas, column-major RLE out.
+// A pixel (row r, col c) is inside if its center (c+0.5, r+0.5) is inside
+// the polygon.  NOTE: the reference's maskApi uses 5x-upsampled boundary
+// rasterization which includes boundary pixels more aggressively; for
+// evaluation purposes (crowd regions, polygon→RLE of large objects) the
+// center-sampling rule differs by at most the 1-px boundary ring — the
+// difference is documented, not hidden.
+int64_t rle_from_poly(const double* xy, int64_t k, int64_t h, int64_t w,
+                      uint32_t* counts_out) {
+  std::vector<uint8_t> mask((size_t)(h * w), 0);
+  for (int64_t col = 0; col < w; ++col) {
+    const double cx = col + 0.5;
+    // collect crossings of the vertical line x=cx with polygon edges
+    std::vector<double> ys;
+    for (int64_t i = 0; i < k; ++i) {
+      const double x1 = xy[2 * i], y1 = xy[2 * i + 1];
+      const double x2 = xy[2 * ((i + 1) % k)], y2 = xy[2 * ((i + 1) % k) + 1];
+      if ((x1 <= cx && x2 > cx) || (x2 <= cx && x1 > cx)) {
+        const double t = (cx - x1) / (x2 - x1);
+        ys.push_back(y1 + t * (y2 - y1));
+      }
+    }
+    std::sort(ys.begin(), ys.end());
+    for (size_t j = 0; j + 1 < ys.size(); j += 2) {
+      int64_t r0 = (int64_t)std::max(0.0, std::ceil(ys[j] - 0.5));
+      int64_t r1 = (int64_t)std::min((double)h - 1, std::floor(ys[j + 1] - 0.5));
+      for (int64_t r = r0; r <= r1; ++r) mask[(size_t)(col * h + r)] = 1;
+    }
+  }
+  return rle_encode(mask.data(), h, w, counts_out);
+}
+
+// Axis-aligned box (x, y, w, h COCO convention) to RLE.
+int64_t rle_from_bbox(const double* bb, int64_t h, int64_t w,
+                      uint32_t* counts_out) {
+  const double xy[8] = {bb[0], bb[1], bb[0], bb[1] + bb[3],
+                        bb[0] + bb[2], bb[1] + bb[3], bb[0] + bb[2], bb[1]};
+  return rle_from_poly(xy, 4, h, w, counts_out);
+}
+
+}  // extern "C"

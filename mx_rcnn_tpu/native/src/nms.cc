@@ -1,0 +1,80 @@
+// Host-side box kernels: IoU matrix and greedy NMS.
+//
+// Reference: rcnn/cython/bbox.pyx (bbox_overlaps_cython) and
+// rcnn/cython/cpu_nms.pyx — the two Cython hot loops the reference compiles
+// for the host eval path.  The DEVICE path in this framework is the jnp/XLA
+// NMS (mx_rcnn_tpu/ops/nms.py); this library serves the host-side
+// postprocessing path (per-class NMS over thousands of detections per image
+// in rcnn/core/tester.py — pred_eval) where a ctypes call into -O3 C++ beats
+// both a device round-trip on tiny inputs and pure NumPy on large ones.
+//
+// Semantics match the reference kernels exactly: +1 pixel box areas, strict
+// ">" threshold comparison is NOT used — suppression is "iou > thresh" like
+// cpu_nms.pyx (which keeps boxes with iou == thresh), and input boxes are
+// expected pre-sorted by descending score (the Python wrapper sorts).
+
+#include <cstdint>
+#include <vector>
+
+extern "C" {
+
+// IoU matrix: boxes (n,4) x query_boxes (k,4) -> overlaps (n,k), all fp32,
+// boxes as (x1, y1, x2, y2) with inclusive pixel corners (+1 areas).
+void bbox_overlaps(const float* boxes, int64_t n, const float* query,
+                   int64_t k, float* out) {
+  for (int64_t j = 0; j < k; ++j) {
+    const float qx1 = query[j * 4 + 0], qy1 = query[j * 4 + 1];
+    const float qx2 = query[j * 4 + 2], qy2 = query[j * 4 + 3];
+    const float qarea = (qx2 - qx1 + 1.0f) * (qy2 - qy1 + 1.0f);
+    for (int64_t i = 0; i < n; ++i) {
+      const float bx1 = boxes[i * 4 + 0], by1 = boxes[i * 4 + 1];
+      const float bx2 = boxes[i * 4 + 2], by2 = boxes[i * 4 + 3];
+      const float iw =
+          (bx2 < qx2 ? bx2 : qx2) - (bx1 > qx1 ? bx1 : qx1) + 1.0f;
+      float v = 0.0f;
+      if (iw > 0) {
+        const float ih =
+            (by2 < qy2 ? by2 : qy2) - (by1 > qy1 ? by1 : qy1) + 1.0f;
+        if (ih > 0) {
+          const float barea = (bx2 - bx1 + 1.0f) * (by2 - by1 + 1.0f);
+          v = iw * ih / (barea + qarea - iw * ih);
+        }
+      }
+      out[i * k + j] = v;
+    }
+  }
+}
+
+// Greedy NMS over score-sorted dets (n,5) [x1 y1 x2 y2 score].
+// Writes kept indices into keep (caller-allocated, size n); returns count.
+int64_t cpu_nms(const float* dets, int64_t n, float thresh, int64_t* keep) {
+  std::vector<uint8_t> suppressed(n, 0);
+  std::vector<float> areas(n);
+  for (int64_t i = 0; i < n; ++i) {
+    areas[i] = (dets[i * 5 + 2] - dets[i * 5 + 0] + 1.0f) *
+               (dets[i * 5 + 3] - dets[i * 5 + 1] + 1.0f);
+  }
+  int64_t num_keep = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (suppressed[i]) continue;
+    keep[num_keep++] = i;
+    const float ix1 = dets[i * 5 + 0], iy1 = dets[i * 5 + 1];
+    const float ix2 = dets[i * 5 + 2], iy2 = dets[i * 5 + 3];
+    for (int64_t j = i + 1; j < n; ++j) {
+      if (suppressed[j]) continue;
+      const float xx1 = ix1 > dets[j * 5 + 0] ? ix1 : dets[j * 5 + 0];
+      const float yy1 = iy1 > dets[j * 5 + 1] ? iy1 : dets[j * 5 + 1];
+      const float xx2 = ix2 < dets[j * 5 + 2] ? ix2 : dets[j * 5 + 2];
+      const float yy2 = iy2 < dets[j * 5 + 3] ? iy2 : dets[j * 5 + 3];
+      const float w = xx2 - xx1 + 1.0f;
+      const float h = yy2 - yy1 + 1.0f;
+      if (w <= 0 || h <= 0) continue;
+      const float inter = w * h;
+      const float iou = inter / (areas[i] + areas[j] - inter);
+      if (iou > thresh) suppressed[j] = 1;
+    }
+  }
+  return num_keep;
+}
+
+}  // extern "C"

@@ -1,0 +1,259 @@
+"""Train-step time breakdown: where do the milliseconds go?
+
+Reference analog: none — the reference has no profiler wiring beyond
+``Speedometer`` (SURVEY.md §5.1).  On TPU the jitted step is one opaque XLA
+program, so this tool attributes time by *ablation*: it compiles and times
+each stage of the step (backbone, RPN losses, proposal NMS, targets,
+ROIAlign, ROI head, full step) on the real device and reports per-stage
+milliseconds.
+
+Measurement methodology (important on tunneled devices): a host→device
+round-trip can cost ~100 ms, so single-call timing drowns in RTT.  Each
+stage is wrapped in a ``lax.fori_loop`` that runs it N times inside ONE
+XLA program with an unfoldable data dependency (carry · 1e-30 injected into
+the stage input, carry re-derived from the stage output), then timed with a
+single dispatch + fetch; per-iteration time = (wall − RTT) / N.
+
+Usage:
+  python -m mx_rcnn_tpu.tools.profile_step --network resnet101 --iters 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import sys
+import time
+
+import numpy as np
+
+
+def make_batch(cfg, batch_images, h, w, seed=0):
+    import jax.numpy as jnp
+
+    from mx_rcnn_tpu.core.train import Batch
+
+    rng = np.random.RandomState(seed)
+    g = cfg.train.max_gt_boxes
+    n_gt = 8
+    gt_boxes = np.zeros((batch_images, g, 4), np.float32)
+    gt_classes = np.zeros((batch_images, g), np.int32)
+    gt_valid = np.zeros((batch_images, g), bool)
+    for i in range(batch_images):
+        xy = rng.uniform(0, 500, (n_gt, 2))
+        wh = rng.uniform(60, 300, (n_gt, 2))
+        gt_boxes[i, :n_gt, :2] = xy
+        gt_boxes[i, :n_gt, 2:] = np.minimum(xy + wh, [w - 1, h - 1])
+        gt_classes[i, :n_gt] = rng.randint(1, cfg.dataset.num_classes, n_gt)
+        gt_valid[i, :n_gt] = True
+    return Batch(
+        images=jnp.asarray(rng.randn(batch_images, h, w, 3), jnp.float32),
+        im_info=jnp.tile(jnp.array([[float(h), float(w), 1.0]]),
+                         (batch_images, 1)),
+        gt_boxes=jnp.asarray(gt_boxes),
+        gt_classes=jnp.asarray(gt_classes),
+        gt_valid=jnp.asarray(gt_valid),
+    )
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--network", default="resnet101")
+    p.add_argument("--dataset", default="coco")
+    p.add_argument("--batch_images", type=int, default=2)
+    p.add_argument("--shape", default="608x1024")
+    p.add_argument("--iters", type=int, default=20,
+                   help="loop length inside the timed XLA program")
+    p.add_argument("--trace_dir", default=None,
+                   help="also dump a jax.profiler trace here")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.core.train import make_train_step, setup_training
+    from mx_rcnn_tpu.models import build_model
+    from mx_rcnn_tpu.ops.proposal import propose
+    from mx_rcnn_tpu.ops.roi_pool import roi_align
+    from mx_rcnn_tpu.ops.targets import anchor_target, proposal_target
+
+    h, w = (int(v) for v in args.shape.split("x"))
+    n = args.batch_images
+    N = args.iters
+    cfg = generate_config(args.network, args.dataset)
+    cfg = cfg.replace_in("train", batch_images=n)
+    model = build_model(cfg)
+    tr = cfg.train
+    key = jax.random.PRNGKey(0)
+    batch = make_batch(cfg, n, h, w)
+
+    print(f"device: {jax.devices()[0].platform} "
+          f"({jax.devices()[0].device_kind}); loop N={N}", file=sys.stderr)
+    state, tx = setup_training(model, cfg, key, (n, h, w, 3),
+                               steps_per_epoch=10_000)
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+
+    def fetch(x):
+        return np.asarray(jax.tree_util.tree_leaves(x)[0]).ravel()[:1]
+
+    # tunnel round-trip floor: timing of a trivial fetched program
+    tiny = jax.jit(lambda c: c + 1.0)
+    fetch(tiny(jnp.float32(0)))
+    t0 = time.perf_counter()
+    fetch(tiny(jnp.float32(0)))
+    rtt = time.perf_counter() - t0
+    print(f"{'fetch round-trip (floor)':<34s} {rtt * 1e3:9.2f} ms",
+          flush=True)
+
+    def timed_loop(stage, label, note=""):
+        """stage: carry (f32 scalar) -> carry.  Runs N reps in one program."""
+        looped = jax.jit(lambda c: jax.lax.fori_loop(
+            0, N, lambda i, cc: stage(cc), c))
+        fetch(looped(jnp.float32(0)))  # compile + warm
+        t0 = time.perf_counter()
+        fetch(looped(jnp.float32(0)))
+        per = (time.perf_counter() - t0 - rtt) / N
+        print(f"{label:<34s} {per * 1e3:9.2f} ms  {note}", flush=True)
+        return per
+
+    def carry_of(x):
+        return jax.tree_util.tree_leaves(x)[0].ravel()[0].astype(jnp.float32)
+
+    eps = jnp.float32(1e-30)
+
+    # --- stages ------------------------------------------------------------
+    def feat_of(images):
+        return model.apply(variables, images, method=model.features)
+
+    t_feat = timed_loop(
+        lambda c: carry_of(feat_of(batch.images + c * eps)),
+        "backbone fwd")
+
+    feat = jax.jit(feat_of)(batch.images)
+    _, fh, fw, fc = feat.shape
+    anchors = jnp.asarray(model.anchors_for(fh, fw))
+
+    def feat_bwd(c):
+        def f(p):
+            y = model.apply({**variables, "params": p},
+                            batch.images + c * eps, method=model.features)
+            return (y.astype(jnp.float32) ** 2).mean()
+        g = jax.grad(f)(variables["params"])
+        return carry_of(g)
+
+    t_feat_bwd = timed_loop(feat_bwd, "backbone fwd+bwd (dummy loss)")
+
+    rpn_cls, rpn_box = jax.jit(
+        lambda v, f: model.apply(v, f, method=model.rpn_raw))(variables, feat)
+    fg = jax.nn.softmax(rpn_cls.astype(jnp.float32), axis=-1)[..., 1]
+    box32 = rpn_box.astype(jnp.float32)
+
+    prop_one = functools.partial(
+        propose, pre_nms_top_n=tr.rpn_pre_nms_top_n,
+        post_nms_top_n=tr.rpn_post_nms_top_n,
+        nms_thresh=tr.rpn_nms_thresh, min_size=tr.rpn_min_size)
+
+    def prop_stage(c):
+        rois, _, _ = jax.vmap(prop_one, in_axes=(0, 0, None, 0))(
+            fg + c * eps, box32, anchors, batch.im_info)
+        return carry_of(rois)
+
+    t_prop = timed_loop(prop_stage, "proposal (decode+topk+NMS)",
+                        f"pre={tr.rpn_pre_nms_top_n} "
+                        f"post={tr.rpn_post_nms_top_n}")
+
+    rois, _, rois_valid = jax.jit(jax.vmap(
+        prop_one, in_axes=(0, 0, None, 0)))(fg, box32, anchors, batch.im_info)
+
+    at_one = functools.partial(
+        anchor_target, rpn_batch_size=tr.rpn_batch_size,
+        rpn_fg_fraction=tr.rpn_fg_fraction,
+        positive_overlap=tr.rpn_positive_overlap,
+        negative_overlap=tr.rpn_negative_overlap,
+        clobber_positives=tr.rpn_clobber_positives,
+        allowed_border=tr.rpn_allowed_border,
+        bbox_weights=tr.rpn_bbox_weights)
+    keys = jax.random.split(key, n)
+
+    def at_stage(c):
+        at = jax.vmap(at_one, in_axes=(None, 0, 0, 0, 0))(
+            anchors, batch.gt_boxes + c * eps, batch.gt_valid,
+            batch.im_info, keys)
+        return carry_of(at.bbox_targets)
+
+    t_at = timed_loop(at_stage, "anchor_target",
+                      f"anchors={anchors.shape[0]}")
+
+    pt_one = functools.partial(
+        proposal_target, num_classes=model.num_classes,
+        batch_rois=tr.batch_rois, fg_fraction=tr.fg_fraction,
+        fg_thresh=tr.fg_thresh, bg_thresh_hi=tr.bg_thresh_hi,
+        bg_thresh_lo=tr.bg_thresh_lo, bbox_means=tr.bbox_means,
+        bbox_stds=tr.bbox_stds, gt_append=tr.gt_append)
+
+    def pt_stage(c):
+        pt = jax.vmap(pt_one)(rois + c * eps, rois_valid, batch.gt_boxes,
+                              batch.gt_classes, batch.gt_valid, keys)
+        return carry_of(pt.rois)
+
+    t_pt = timed_loop(pt_stage, "proposal_target")
+
+    pt = jax.jit(jax.vmap(pt_one))(rois, rois_valid, batch.gt_boxes,
+                                   batch.gt_classes, batch.gt_valid, keys)
+
+    def ra_stage(c):
+        pooled = jax.vmap(lambda f, r: roi_align(
+            f, r, model.pooled_size, 1.0 / model.feat_stride))(
+                feat + c * eps.astype(feat.dtype), pt.rois)
+        return carry_of(pooled)
+
+    t_ra = timed_loop(ra_stage, "roi_align",
+                      f"rois={pt.rois.shape[0] * pt.rois.shape[1]}")
+
+    pooled = jax.jit(jax.vmap(lambda f, r: roi_align(
+        f, r, model.pooled_size, 1.0 / model.feat_stride)))(feat, pt.rois)
+    flat = pooled.reshape((-1,) + pooled.shape[2:])
+
+    def head_stage(c):
+        def f(p):
+            cl, b = model.apply(
+                {**variables, "params": p},
+                flat + c * eps.astype(flat.dtype), True,
+                method=model.roi_head, rngs={"dropout": jax.random.PRNGKey(0)})
+            return (cl.astype(jnp.float32) ** 2).mean() + \
+                   (b.astype(jnp.float32) ** 2).mean()
+        return carry_of(jax.grad(f)(variables["params"]))
+
+    t_head = timed_loop(head_stage, "roi head fwd+bwd (dummy loss)",
+                        f"rois={flat.shape[0]}")
+
+    # --- full step (natural chaining through the state) --------------------
+    step = jax.jit(make_train_step(model, cfg, tx), donate_argnums=(0,))
+    s = state
+    for _ in range(2):
+        s, metrics = step(s, batch, key)
+    fetch(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(N):
+        s, metrics = step(s, batch, key)
+    fetch(metrics["loss"])
+    t_full = (time.perf_counter() - t0 - rtt) / N
+    print(f"{'FULL train step (donated)':<34s} {t_full * 1e3:9.2f} ms  "
+          f"imgs/s/chip={n / t_full:.1f}", flush=True)
+
+    acct = t_feat_bwd + t_prop + t_at + t_pt + t_ra + t_head
+    print(f"{'sum of pieces (approx)':<34s} {acct * 1e3:9.2f} ms", flush=True)
+
+    if args.trace_dir:
+        import jax.profiler
+
+        with jax.profiler.trace(args.trace_dir):
+            for _ in range(3):
+                s, metrics = step(s, batch, key)
+            fetch(metrics["loss"])
+        print(f"trace written to {args.trace_dir}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -150,3 +150,35 @@ def test_synthetic_eval_selfconsistent(tmp_path):
             all_boxes[int(cls)][i] = np.vstack([all_boxes[int(cls)][i], det])
     res = ds.evaluate_detections(all_boxes)
     assert res["mAP"] > 0.95, res
+
+
+def test_prefetch_loader_identical_batches(tmp_path):
+    """Prefetched iteration must yield batches identical (content and
+    order) to the synchronous path — thread-pool assembly is an overlap
+    optimization, never a semantics change."""
+    cfg = generate_config("tiny", "PascalVOC")
+    cfg = cfg.replace_in("bucket", shapes=((128, 160), (160, 128)),
+                         scale=120, max_size=160)
+    cfg = cfg.replace_in("train", max_gt_boxes=8)
+    ds = SyntheticDataset("train", str(tmp_path), "", num_images=10,
+                          image_size=(96, 128))
+    roidb = ds.gt_roidb()
+
+    sync = AnchorLoader(roidb, cfg, batch_images=2, shuffle=True, seed=3,
+                        num_workers=0)
+    pre = AnchorLoader(roidb, cfg, batch_images=2, shuffle=True, seed=3,
+                       num_workers=3, prefetch=4)
+    sync.set_epoch(1)
+    pre.set_epoch(1)
+    got_s, got_p = list(sync), list(pre)
+    assert len(got_s) == len(got_p) > 0
+    for bs, bp in zip(got_s, got_p):
+        for fs, fp in zip(bs, bp):
+            np.testing.assert_array_equal(np.asarray(fs), np.asarray(fp))
+
+    tls = TestLoader(roidb, cfg, batch_images=3, num_workers=0)
+    tlp = TestLoader(roidb, cfg, batch_images=3, num_workers=3, prefetch=2)
+    for (b1, i1, s1), (b2, i2, s2) in zip(tls, tlp):
+        assert i1 == i2
+        np.testing.assert_array_equal(s1, s2)
+        np.testing.assert_array_equal(b1.images, b2.images)
