@@ -67,3 +67,21 @@ def test_roi_align_bf16_passthrough():
     rois = jnp.array([[0.0, 0.0, 32.0, 32.0]])
     out = roi_align(feat, rois, (7, 7), 0.25)
     assert out.dtype == jnp.bfloat16
+
+
+def test_roi_align_bf16_close_to_fp32():
+    """The bf16 fast path (default precision, folded-mean matrices) must
+    track the fp32 'highest' path within bf16 quantization error."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    feat = rng.randn(24, 32, 16).astype(np.float32)
+    rois = np.array([[10.0, 8.0, 200.0, 150.0],
+                     [0.0, 0.0, 511.0, 383.0],
+                     [33.3, 21.7, 95.2, 64.9]], np.float32)
+    out32 = np.asarray(roi_align(jnp.asarray(feat), rois, (7, 7), 1 / 16.0))
+    out16 = np.asarray(roi_align(jnp.asarray(feat, jnp.bfloat16), rois,
+                                 (7, 7), 1 / 16.0)).astype(np.float32)
+    # bf16 has ~2-3 significant decimal digits; interpolated activations are
+    # O(1), so 3% absolute tolerance is ~4x the expected rounding noise
+    np.testing.assert_allclose(out16, out32, atol=3e-2)
