@@ -158,6 +158,10 @@ class DefaultConfig:
     wd: float = 0.0005
     lr_factor: float = 0.1
     clip_gradient: float = 5.0
+    # linear LR warmup (upstream WarmupMultiFactorScheduler; off by default
+    # to match the reference scripts — enable at large DP batch)
+    warmup_step: int = 0
+    warmup_lr: float = 0.0
     # host input pipeline (TPU addition; the ref loader is synchronous —
     # SURVEY.md §7 "Hard parts": cv2 decode must overlap device steps)
     num_workers: int = 4

@@ -88,6 +88,7 @@ def fit(
     mesh=None,
     mode: str = "e2e",
     epoch_end_callback: Optional[Callable[[int, TrainState], None]] = None,
+    profile_dir: Optional[str] = None,
 ) -> TrainState:
     """Run ``begin_epoch .. num_epochs`` epochs; checkpoint per epoch.
 
@@ -96,6 +97,9 @@ def fit(
     ``mode``: 'e2e' | 'rpn' | 'rcnn' (alternate-training stages).
     ``key`` is the base RNG; the step folds in ``state.step`` so resuming
     from a checkpoint replays the identical sample stream.
+    ``profile_dir``: capture a ``jax.profiler`` trace of a few early steps
+    (after compile warm-up) into this directory for tensorboard inspection;
+    the coarse per-stage breakdown lives in ``tools/profile_step.py``.
     """
     frequent = cfg.default.frequent if frequent is None else frequent
     if mesh is not None and mesh.size > 1:
@@ -124,10 +128,22 @@ def fit(
         epoch_metrics: List[Dict] = []
         t0 = time.perf_counter()
         nbatch = 0
+        tracing = False
         for batch in train_loader:
+            # trace steps [2, 5) of the first epoch: step 0/1 carry compile
+            if (profile_dir is not None and epoch == begin_epoch
+                    and nbatch == 2):
+                jax.profiler.start_trace(profile_dir)
+                tracing = True
+                logger.info("profiler trace started -> %s", profile_dir)
             state, metrics = run_step(state, batch)
             window.append(metrics)
             nbatch += 1
+            if tracing and nbatch >= 5:
+                jax.block_until_ready(metrics)
+                jax.profiler.stop_trace()
+                tracing = False
+                logger.info("profiler trace written to %s", profile_dir)
             if nbatch % frequent == 0:
                 avg = _mean_metrics(window)
                 epoch_metrics.append(avg)
@@ -135,6 +151,10 @@ def fit(
                 speedo(epoch, nbatch, avg)
             else:
                 speedo(epoch, nbatch, {})
+        if tracing:  # epoch shorter than the trace window
+            jax.block_until_ready(metrics)
+            jax.profiler.stop_trace()
+            logger.info("profiler trace written to %s", profile_dir)
         if window:
             epoch_metrics.append(_mean_metrics(window))
         if epoch_metrics:

@@ -26,13 +26,32 @@ from mx_rcnn_tpu.config import Config
 
 
 def lr_schedule(base_lr: float, lr_step_epochs: Sequence[int],
-                steps_per_epoch: int, factor: float = 0.1) -> optax.Schedule:
-    """Step-decay schedule (ref MultiFactorScheduler semantics: multiply lr
-    by ``factor`` when crossing each epoch boundary in ``lr_step``)."""
+                steps_per_epoch: int, factor: float = 0.1,
+                warmup_step: int = 0, warmup_lr: float = 0.0
+                ) -> optax.Schedule:
+    """Step-decay schedule with optional linear warmup.
+
+    Step decay follows ref MultiFactorScheduler semantics (multiply lr by
+    ``factor`` when crossing each epoch boundary in ``lr_step``); warmup
+    follows the upstream lineage's WarmupMultiFactorScheduler
+    (``warmup='linear'``: ramp from ``warmup_lr`` to ``base_lr`` over
+    ``warmup_step`` steps) — off by default, matters at large DP batch.
+    """
     boundaries = {
         int(e) * steps_per_epoch: factor for e in lr_step_epochs if int(e) > 0
     }
-    return optax.piecewise_constant_schedule(base_lr, boundaries)
+    decay = optax.piecewise_constant_schedule(base_lr, boundaries)
+    if warmup_step <= 0:
+        return decay
+
+    def schedule(count):
+        # decay boundaries count from global step 0 (the ref scheduler also
+        # counts warmup steps against the decay boundaries)
+        frac = jnp.minimum(count / warmup_step, 1.0)
+        warm = warmup_lr + (base_lr - warmup_lr) * frac
+        return jnp.where(count < warmup_step, warm, decay(count))
+
+    return schedule
 
 
 def parse_lr_step(lr_step: str) -> Tuple[int, ...]:
@@ -92,7 +111,9 @@ def make_optimizer(
     if frozen_prefixes is None:
         frozen_prefixes = cfg.network.fixed_params
     sched = lr_schedule(base_lr, parse_lr_step(lr_step), steps_per_epoch,
-                        cfg.default.lr_factor)
+                        cfg.default.lr_factor,
+                        warmup_step=cfg.default.warmup_step,
+                        warmup_lr=cfg.default.warmup_lr)
     sgd = optax.chain(
         # ref optimizer_params: elementwise clip_gradient=5 before update
         optax.clip(cfg.default.clip_gradient),

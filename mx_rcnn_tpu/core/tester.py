@@ -141,10 +141,16 @@ def im_detect_batch(
 
 
 def pred_eval(predictor: Predictor, test_loader, imdb, cfg: Config,
-              out_dir: str = None, verbose: bool = True) -> Dict[str, float]:
+              out_dir: str = None, verbose: bool = True,
+              save_dets: str = None) -> Dict[str, float]:
     """Full evaluation loop (ref ``pred_eval``): forward every image,
     per-class score threshold + NMS, cap ``max_per_image``, then
-    ``imdb.evaluate_detections``."""
+    ``imdb.evaluate_detections``.
+
+    ``save_dets``: pickle the raw ``all_boxes`` detections here before
+    evaluating (ref ``pred_eval`` caches ``detections.pkl``), enabling
+    ``tools/reeval.py`` to re-score without re-running the model.
+    """
     num_classes = imdb.num_classes
     num_images = len(test_loader.roidb)
     all_boxes: List[List[np.ndarray]] = [
@@ -190,6 +196,14 @@ def pred_eval(predictor: Predictor, test_loader, imdb, cfg: Config,
         done += len(indices)
         if verbose:
             print(f"eval: {done}/{num_images} images")
+    if save_dets:
+        import os
+        import pickle
+
+        os.makedirs(os.path.dirname(save_dets) or ".", exist_ok=True)
+        with open(save_dets, "wb") as f:
+            pickle.dump({"all_boxes": all_boxes, "classes": imdb.classes},
+                        f, protocol=pickle.HIGHEST_PROTOCOL)
     results = imdb.evaluate_detections(all_boxes, out_dir) if out_dir \
         else imdb.evaluate_detections(all_boxes)
     return results

@@ -35,6 +35,39 @@ from mx_rcnn_tpu.ops.boxes import bbox_overlaps
 
 _NEG = jnp.float32(-1e10)
 
+# Suppression-sweep backend: the Pallas kernel (ops/nms_pallas.py) keeps the
+# whole sweep in VMEM; the jnp sweep below is the oracle and the fallback.
+# "auto" = Pallas on real TPU, jnp elsewhere (the kernel runs under
+# interpret=True on CPU, which is only useful for testing).
+_BACKEND = "auto"
+
+
+def set_nms_backend(name: str) -> None:
+    """Select 'auto' | 'pallas' | 'jnp' for subsequent traces.
+
+    NOTE: jitted callers cache per static-arg signature; pass an explicit
+    ``backend=`` to :func:`nms`/:func:`nms_mask` (as the tests do) to force
+    a retrace rather than flipping this global mid-run.
+    """
+    global _BACKEND
+    if name not in ("auto", "pallas", "jnp"):
+        raise ValueError(f"unknown NMS backend {name!r}")
+    _BACKEND = name
+
+
+def _resolve_backend(backend: Optional[str], k: int, tile: int) -> str:
+    b = backend or _BACKEND
+    if b == "auto":
+        # lane-alignment guard: the kernel's (1, K)/(T, K) blocks want K and
+        # T in whole 128-lane registers; odd shapes fall back to jnp.
+        # VMEM guard: the (T, K) fp32 IoU slab must fit comfortably —
+        # 16 MB covers the production proposal shape (256 x 12032 ≈ 12.3 MB,
+        # verified on v5e) with headroom for Mosaic temporaries.
+        fits = tile * k * 4 <= 16 * 1024 * 1024
+        b = "pallas" if (jax.default_backend() == "tpu" and fits
+                         and tile % 128 == 0 and k % tile == 0) else "jnp"
+    return b
+
 
 def _suppression_sweep(
     boxes: jnp.ndarray,
@@ -90,6 +123,7 @@ def _sorted_survivors(
     valid: Optional[jnp.ndarray],
     iou_threshold: float,
     tile_size: int,
+    backend: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, int, int]:
     """Shared preamble of nms/nms_mask: mask invalid scores, pad to a tile
     multiple, sort by score, run the suppression sweep.
@@ -109,12 +143,20 @@ def _sorted_survivors(
         boxes = jnp.concatenate([boxes, jnp.zeros((pad, 4), jnp.float32)], axis=0)
         scores = jnp.concatenate([scores, jnp.full((pad,), _NEG)], axis=0)
     order = jnp.argsort(-scores)
-    keep = _suppression_sweep(boxes[order], scores[order] > _NEG / 2,
-                              iou_threshold, t)
+    alive0 = scores[order] > _NEG / 2
+    if _resolve_backend(backend, k + pad, t) == "pallas":
+        from mx_rcnn_tpu.ops.nms_pallas import suppression_sweep_pallas
+
+        keep = suppression_sweep_pallas(
+            boxes[order], alive0, iou_threshold, t,
+            interpret=jax.default_backend() != "tpu")
+    else:
+        keep = _suppression_sweep(boxes[order], alive0, iou_threshold, t)
     return order, keep, pad, t
 
 
-@functools.partial(jax.jit, static_argnames=("iou_threshold", "max_output", "tile_size"))
+@functools.partial(jax.jit, static_argnames=("iou_threshold", "max_output",
+                                             "tile_size", "backend"))
 def nms(
     boxes: jnp.ndarray,
     scores: jnp.ndarray,
@@ -122,6 +164,7 @@ def nms(
     max_output: int,
     valid: Optional[jnp.ndarray] = None,
     tile_size: int = 256,
+    backend: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Greedy NMS; returns up to ``max_output`` surviving indices by score.
 
@@ -140,7 +183,7 @@ def nms(
         return (jnp.full((max_output,), -1, jnp.int32),
                 jnp.zeros((max_output,), bool))
     order, keep, _, t = _sorted_survivors(boxes, scores, valid,
-                                          iou_threshold, tile_size)
+                                          iou_threshold, tile_size, backend)
     # Compact survivors (in score order) into a fixed buffer.
     pos = jnp.cumsum(keep) - 1
     emit = keep & (pos < max_output)
@@ -152,13 +195,15 @@ def nms(
     return out_idx, out_valid
 
 
-@functools.partial(jax.jit, static_argnames=("iou_threshold", "tile_size"))
+@functools.partial(jax.jit, static_argnames=("iou_threshold", "tile_size",
+                                             "backend"))
 def nms_mask(
     boxes: jnp.ndarray,
     scores: jnp.ndarray,
     iou_threshold: float,
     valid: Optional[jnp.ndarray] = None,
     tile_size: int = 256,
+    backend: Optional[str] = None,
 ) -> jnp.ndarray:
     """Greedy NMS returning a keep mask in the *original* box order.
 
@@ -168,7 +213,7 @@ def nms_mask(
     k = boxes.shape[0]
     if k == 0:
         return jnp.zeros((0,), bool)
-    order, keep_sorted, pad, _ = _sorted_survivors(boxes, scores, valid,
-                                                   iou_threshold, tile_size)
+    order, keep_sorted, pad, _ = _sorted_survivors(
+        boxes, scores, valid, iou_threshold, tile_size, backend)
     keep = jnp.zeros((k + pad,), dtype=bool).at[order].set(keep_sorted)
     return keep[:k]

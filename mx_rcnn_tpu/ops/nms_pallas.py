@@ -1,0 +1,165 @@
+"""Pallas TPU kernel for the greedy-NMS suppression sweep.
+
+Reference: ``rcnn/cython/nms_kernel.cu`` — the classic triangular-bitmask
+CUDA NMS (64-box blocks, device-wide bitmask, host-side final reduction).
+
+This is the Pallas counterpart of ``ops/nms.py — _suppression_sweep`` (the
+jnp fallback, which stays as the oracle): boxes arrive score-sorted, the
+kernel walks tiles of T boxes through a sequential 1-D grid, and for each
+tile (a) suppresses by the finalized survivors of all earlier tiles, then
+(b) resolves the within-tile greedy chain by fixed-point iteration —
+bit-identical decisions to sequential greedy NMS.
+
+Why a kernel helps on TPU: the whole sweep runs out of VMEM — the (T, K)
+IoU slab, the box coordinates, and the keep mask never round-trip to HBM
+between tiles, and the keep mask accumulates in place across grid steps
+(constant-index output block + input/output aliasing), where the XLA
+version re-materializes masks per fori_loop iteration.
+
+Numerics mirror ``ops/boxes.py — bbox_overlaps`` exactly (+1 pixel areas,
+``union > 0`` guard, ``iou > threshold`` suppression), so the two backends
+agree decision-for-decision, not just approximately.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _sweep_kernel(boxes_ref, boxes_t_ref, keep_in_ref, keep_ref, *,
+                  tile: int, iou_threshold: float):
+    """One grid step = one tile of ``tile`` sorted boxes.
+
+    boxes_ref: (K, 4) fp32 score-sorted boxes (VMEM).
+    boxes_t_ref: (4, K) the same boxes transposed (broadcast-friendly rows).
+    keep_in_ref / keep_ref: (1, K) fp32 alive mask.  The input is aliased
+      onto the output HBM buffer, but the output VMEM window is NOT
+      guaranteed to hold the aliased input's contents before the first
+      write — so program 0 explicitly seeds the output block from the input
+      block; later grid steps read/write only ``keep_ref`` (constant-index
+      block, resident in VMEM across the sequential grid).
+    """
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _seed():
+        keep_ref[:, :] = keep_in_ref[:, :]
+
+    k = boxes_t_ref.shape[1]
+    t = tile
+    start = i * t
+
+    tile_boxes = boxes_ref[pl.ds(start, t), :]          # (T, 4)
+    tx1 = tile_boxes[:, 0:1]                            # (T, 1)
+    ty1 = tile_boxes[:, 1:2]
+    tx2 = tile_boxes[:, 2:3]
+    ty2 = tile_boxes[:, 3:4]
+    x1 = boxes_t_ref[0:1, :]                            # (1, K)
+    y1 = boxes_t_ref[1:2, :]
+    x2 = boxes_t_ref[2:3, :]
+    y2 = boxes_t_ref[3:4, :]
+
+    # IoU of the tile rows against every box — semantics of bbox_overlaps
+    iw = jnp.maximum(jnp.minimum(tx2, x2) - jnp.maximum(tx1, x1) + 1.0, 0.0)
+    ih = jnp.maximum(jnp.minimum(ty2, y2) - jnp.maximum(ty1, y1) + 1.0, 0.0)
+    inter = iw * ih                                     # (T, K)
+    area_t = (tx2 - tx1 + 1.0) * (ty2 - ty1 + 1.0)      # (T, 1)
+    area_a = (x2 - x1 + 1.0) * (y2 - y1 + 1.0)          # (1, K)
+    union = area_t + area_a - inter
+    iou = jnp.where(union > 0, inter / jnp.maximum(union, 1e-12), 0.0)
+    over = (iou > iou_threshold).astype(jnp.float32)    # (T, K)
+
+    keep = keep_ref[0:1, :]                             # (1, K) 1.0/0.0
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, k), 1)
+    earlier = jnp.where(col < start, keep, 0.0)         # finalized survivors
+    sup_prev = jnp.max(over * earlier, axis=1, keepdims=True)  # (T, 1)
+    tile_alive0 = keep_ref[0, pl.ds(start, t)].reshape(t, 1)
+    alive0 = tile_alive0 * (1.0 - sup_prev)             # (T, 1)
+
+    # within-tile greedy chain: strictly-earlier suppressors only.  The
+    # (T, T) self-block is recomputed from ref slices (Mosaic does not lower
+    # dynamic_slice of a computed value) — T² IoUs, negligible next to the
+    # (T, K) slab above.
+    sx1 = boxes_t_ref[0:1, pl.ds(start, t)]             # (1, T)
+    sy1 = boxes_t_ref[1:2, pl.ds(start, t)]
+    sx2 = boxes_t_ref[2:3, pl.ds(start, t)]
+    sy2 = boxes_t_ref[3:4, pl.ds(start, t)]
+    siw = jnp.maximum(jnp.minimum(tx2, sx2) - jnp.maximum(tx1, sx1) + 1.0,
+                      0.0)
+    sih = jnp.maximum(jnp.minimum(ty2, sy2) - jnp.maximum(ty1, sy1) + 1.0,
+                      0.0)
+    sinter = siw * sih                                  # (T, T)
+    sarea = (sx2 - sx1 + 1.0) * (sy2 - sy1 + 1.0)       # (1, T)
+    sunion = area_t + sarea - sinter
+    siou = jnp.where(sunion > 0, sinter / jnp.maximum(sunion, 1e-12), 0.0)
+    over_self = (siou > iou_threshold).astype(jnp.float32)
+    row = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+    colt = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+    tri = (row < colt).astype(jnp.float32)
+    chain = over_self * tri                             # chain[s, j]
+
+    def fix_cond(state):
+        alive, prev, it = state
+        return jnp.logical_and(jnp.any(alive != prev), it < t)
+
+    def fix_body(state):
+        alive, _, it = state
+        sup = jnp.max(chain * alive, axis=0).reshape(t, 1)  # (T, 1)
+        return alive0 * (1.0 - sup), alive, it + 1
+
+    alive, _, _ = jax.lax.while_loop(
+        fix_cond, fix_body, (alive0, jnp.zeros_like(alive0), 0))
+    keep_ref[0, pl.ds(start, t)] = alive.reshape(t)
+
+
+@functools.partial(jax.jit, static_argnames=("iou_threshold", "tile_size",
+                                             "interpret"))
+def suppression_sweep_pallas(
+    boxes: jnp.ndarray,
+    alive_init: jnp.ndarray,
+    iou_threshold: float,
+    tile_size: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Drop-in Pallas replacement for ``ops/nms.py — _suppression_sweep``.
+
+    Args:
+      boxes: (K, 4) fp32 boxes sorted by descending score; K must be a
+        multiple of ``tile_size`` (the callers pad).
+      alive_init: (K,) bool candidate mask (padding slots False).
+      iou_threshold: suppression threshold.
+      interpret: run the kernel in interpreter mode (CPU testing).
+    Returns:
+      (K,) bool keep mask — exact sequential-greedy-NMS survivors.
+    """
+    k = boxes.shape[0]
+    t = tile_size
+    if k % t != 0:
+        raise ValueError(f"padded box count {k} must be a multiple of {t}")
+    boxes = boxes.astype(jnp.float32)
+    keep0 = alive_init.reshape(1, k).astype(jnp.float32)
+    kernel = functools.partial(_sweep_kernel, tile=t,
+                               iou_threshold=float(iou_threshold))
+    keep = pl.pallas_call(
+        kernel,
+        grid=(k // t,),
+        in_specs=[
+            pl.BlockSpec((k, 4), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((4, k), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, k), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, k), jnp.float32),
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(boxes, boxes.T, keep0)
+    return keep.reshape(k) > 0.5

@@ -228,6 +228,44 @@ def main(argv=None) -> None:
     t_head = timed_loop(head_stage, "roi head fwd+bwd (dummy loss)",
                         f"rois={flat.shape[0]}")
 
+    # --- aggregate ablations ----------------------------------------------
+    from mx_rcnn_tpu.core.train import Batch, loss_and_metrics
+
+    def loss_fwd_stage(c):
+        b = Batch(batch.images + c * eps, batch.im_info, batch.gt_boxes,
+                  batch.gt_classes, batch.gt_valid)
+        total, _ = loss_and_metrics(model, variables["params"],
+                                    variables["batch_stats"], b, key, cfg)
+        return total
+
+    t_loss_fwd = timed_loop(loss_fwd_stage, "full loss fwd (no bwd)")
+
+    def loss_bwd_stage(c):
+        b = Batch(batch.images + c * eps, batch.im_info, batch.gt_boxes,
+                  batch.gt_classes, batch.gt_valid)
+
+        def f(p):
+            total, _ = loss_and_metrics(model, p, variables["batch_stats"],
+                                        b, key, cfg)
+            return total
+
+        return carry_of(jax.grad(f)(variables["params"]))
+
+    t_loss_bwd = timed_loop(loss_bwd_stage, "full loss fwd+bwd (no update)")
+
+    grads = jax.jit(lambda: jax.grad(
+        lambda p: loss_and_metrics(model, p, variables["batch_stats"],
+                                   batch, key, cfg)[0]
+    )(variables["params"]))()
+
+    def opt_stage(c):
+        g = jax.tree_util.tree_map(lambda x: x + c * eps.astype(x.dtype),
+                                   grads)
+        updates, _ = tx.update(g, state.opt_state, variables["params"])
+        return carry_of(updates)
+
+    t_opt = timed_loop(opt_stage, "optimizer update")
+
     # --- full step (natural chaining through the state) --------------------
     step = jax.jit(make_train_step(model, cfg, tx), donate_argnums=(0,))
     s = state

@@ -1,0 +1,81 @@
+"""Re-score saved detections without re-running the model.
+
+Reference: ``rcnn/tools/reeval.py`` — loads the cached ``detections.pkl``
+written by ``pred_eval`` and re-runs ``imdb.evaluate_detections`` (useful
+after changing the eval metric, class list or dataset annotations, and for
+re-scoring the same detections on a different image_set definition).
+
+Usage:
+  python -m mx_rcnn_tpu.tools.test  ... --save_dets dets.pkl
+  python -m mx_rcnn_tpu.tools.reeval --dets dets.pkl --network ... --dataset ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import pickle
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data import load_gt_roidb
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+
+def reeval(cfg, dets_path: str, image_set: str = None, out_dir: str = None,
+           dataset_kw: dict = None):
+    """Load pickled all_boxes and re-run the dataset evaluator."""
+    imdb, _ = load_gt_roidb(cfg, image_set=image_set, training=False,
+                            **(dataset_kw or {}))
+    with open(dets_path, "rb") as f:
+        payload = pickle.load(f)
+    all_boxes = payload["all_boxes"]
+    saved_classes = payload.get("classes")
+    if saved_classes is not None and list(saved_classes) != list(imdb.classes):
+        raise ValueError(
+            f"detections were saved for classes {saved_classes}, the "
+            f"evaluator has {imdb.classes} — wrong --dataset/--network?")
+    if len(all_boxes[0]) != len(imdb.image_index):
+        raise ValueError(
+            f"{len(all_boxes[0])} per-image detection lists for "
+            f"{len(imdb.image_index)} images — wrong --image_set?")
+    results = (imdb.evaluate_detections(all_boxes, out_dir) if out_dir
+               else imdb.evaluate_detections(all_boxes))
+    for k, v in sorted(results.items()):
+        logger.info("%s AP = %.4f", k, v)
+    if "mAP" in results:
+        print(f"mAP = {results['mAP']:.4f}")
+    return results
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        description="Re-evaluate saved detections (ref rcnn/tools/reeval.py)")
+    p.add_argument("--dets", required=True,
+                   help="detections pkl written by tools/test.py --save_dets")
+    p.add_argument("--network", default="resnet101",
+                   choices=["vgg", "resnet50", "resnet101", "tiny"])
+    p.add_argument("--dataset", default="PascalVOC",
+                   choices=["PascalVOC", "coco", "synthetic"])
+    p.add_argument("--image_set", default=None)
+    p.add_argument("--root_path", default=None)
+    p.add_argument("--dataset_path", default=None)
+    p.add_argument("--out_dir", default=None)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    args = parse_args(argv)
+    overrides = {}
+    if args.root_path:
+        overrides["dataset__root_path"] = args.root_path
+    if args.dataset_path:
+        overrides["dataset__dataset_path"] = args.dataset_path
+    cfg = generate_config(args.network, args.dataset, **overrides)
+    reeval(cfg, args.dets, image_set=args.image_set, out_dir=args.out_dir)
+
+
+if __name__ == "__main__":
+    main()

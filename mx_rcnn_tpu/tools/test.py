@@ -22,8 +22,8 @@ logger = logging.getLogger("mx_rcnn_tpu")
 
 def test_rcnn(cfg: Config, *, prefix: str, epoch: int,
               image_set: str = None, out_dir: str = None,
-              verbose: bool = True, dataset_kw: dict = None
-              ) -> Dict[str, float]:
+              verbose: bool = True, dataset_kw: dict = None,
+              save_dets: str = None) -> Dict[str, float]:
     """Evaluate checkpoint ``prefix``@``epoch``; returns the metric dict
     (includes ``mAP`` for VOC-style evaluators)."""
     imdb, roidb = load_gt_roidb(cfg, image_set=image_set, training=False,
@@ -34,7 +34,7 @@ def test_rcnn(cfg: Config, *, prefix: str, epoch: int,
     predictor = Predictor(
         model, {"params": params, "batch_stats": batch_stats}, cfg)
     results = pred_eval(predictor, loader, imdb, cfg, out_dir=out_dir,
-                        verbose=verbose)
+                        verbose=verbose, save_dets=save_dets)
     for k, v in sorted(results.items()):
         logger.info("%s AP = %.4f", k, v)
     if "mAP" in results:
@@ -57,6 +57,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--epoch", type=int, required=True)
     p.add_argument("--out_dir", default=None,
                    help="write detection files here (VOC comp4 / COCO json)")
+    p.add_argument("--save_dets", default=None,
+                   help="pickle raw detections here for tools/reeval.py")
     return p.parse_args(argv)
 
 
@@ -71,7 +73,8 @@ def main(argv=None):
         overrides["dataset__dataset_path"] = args.dataset_path
     cfg = generate_config(args.network, args.dataset, **overrides)
     test_rcnn(cfg, prefix=args.prefix, epoch=args.epoch,
-              image_set=args.image_set, out_dir=args.out_dir)
+              image_set=args.image_set, out_dir=args.out_dir,
+              save_dets=args.save_dets)
 
 
 if __name__ == "__main__":

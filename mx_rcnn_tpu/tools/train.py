@@ -34,7 +34,7 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
               pretrained: str = None, pretrained_epoch: int = 0,
               roidb=None, dataset_kw: dict = None,
               frozen_prefixes=None, mode: str = "e2e", proposals=None,
-              init_from=None):
+              init_from=None, profile_dir: str = None):
     """Train; returns the final TrainState.
 
     ``mode``: 'e2e' | 'rpn' | 'rcnn' — the alternate-training stage drivers
@@ -97,7 +97,7 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
         mesh = device_mesh(num_devices)
     state = fit(model, cfg, state, tx, loader, end_epoch, key,
                 begin_epoch=begin_epoch, prefix=prefix, frequent=frequent,
-                mesh=mesh, mode=mode)
+                mesh=mesh, mode=mode, profile_dir=profile_dir)
     return state
 
 
@@ -131,6 +131,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint under --prefix")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--profile_dir", default=None,
+                   help="capture a jax.profiler trace of early steps here")
     return p.parse_args(argv)
 
 
@@ -164,7 +166,8 @@ def main(argv=None):
               end_epoch=args.end_epoch, lr=args.lr, lr_step=args.lr_step,
               num_devices=args.num_devices, frequent=args.frequent,
               seed=args.seed, pretrained=args.pretrained,
-              pretrained_epoch=args.pretrained_epoch)
+              pretrained_epoch=args.pretrained_epoch,
+              profile_dir=args.profile_dir)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Canonical recipe (ref script/resnet_coco.sh): ResNet-101 Faster R-CNN
+# end2end on COCO (BASELINE.json configs 5/6: v5e-8 DP, per-chip batch 2).
+# Expects COCO under data/coco (annotations/ + train2017/ / val2017/).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m mx_rcnn_tpu.tools.train \
+  --network resnet101 --dataset coco \
+  --prefix model/resnet_coco_e2e --end_epoch 8 --lr 0.001 --lr_step 6 \
+  --batch_images 2 --num_devices "${NUM_DEVICES:-8}" \
+  "$@"
+
+python -m mx_rcnn_tpu.tools.test \
+  --network resnet101 --dataset coco \
+  --prefix model/resnet_coco_e2e --epoch 8

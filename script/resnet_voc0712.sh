@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Canonical recipe (ref script/resnet_voc0712.sh): ResNet-101 Faster R-CNN
+# end2end on VOC07+12 trainval, evaluated on VOC07 test — the mAP north-star
+# config (BASELINE.json config 3: ~79.3 reference mAP).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m mx_rcnn_tpu.tools.train \
+  --network resnet101 --dataset PascalVOC \
+  --image_set 2007_trainval+2012_trainval \
+  --prefix model/resnet_voc0712_e2e --end_epoch 10 --lr 0.001 --lr_step 7 \
+  "$@"
+
+python -m mx_rcnn_tpu.tools.test \
+  --network resnet101 --dataset PascalVOC --image_set 2007_test \
+  --prefix model/resnet_voc0712_e2e --epoch 10

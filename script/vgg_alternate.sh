@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Canonical recipe (ref script/vgg_alternate.sh): the paper's 4-stage
+# alternate training schedule (BASELINE.json config 4): RPN -> proposals ->
+# Fast R-CNN -> RPN (shared convs frozen) -> Fast R-CNN -> combined model.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m mx_rcnn_tpu.tools.train_alternate \
+  --network vgg --dataset PascalVOC --image_set 2007_trainval \
+  --prefix model/vgg_voc07_alt \
+  "$@"
+
+python -m mx_rcnn_tpu.tools.test \
+  --network vgg --dataset PascalVOC --image_set 2007_test \
+  --prefix model/vgg_voc07_alt-final --epoch 1

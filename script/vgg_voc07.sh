@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Canonical recipe (ref script/vgg_voc07.sh): VGG16 Faster R-CNN end2end on
+# VOC07 trainval, evaluated on VOC07 test.  BASELINE.json config 1/2.
+# Expects VOCdevkit under data/ (ref layout: data/VOCdevkit/VOC2007).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m mx_rcnn_tpu.tools.train \
+  --network vgg --dataset PascalVOC --image_set 2007_trainval \
+  --prefix model/vgg_voc07_e2e --end_epoch 10 --lr 0.001 --lr_step 7 \
+  "$@"
+
+python -m mx_rcnn_tpu.tools.test \
+  --network vgg --dataset PascalVOC --image_set 2007_test \
+  --prefix model/vgg_voc07_e2e --epoch 10

@@ -1,0 +1,47 @@
+"""tools/reeval.py: re-score saved detections (ref rcnn/tools/reeval.py)."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data.synthetic import SyntheticDataset
+from mx_rcnn_tpu.tools.reeval import reeval
+
+
+def _perfect_dets(ds):
+    num_images = ds.num_images
+    all_boxes = [[np.zeros((0, 5), np.float32) for _ in range(num_images)]
+                 for _ in range(ds.num_classes)]
+    for i, spec in enumerate(ds._specs):
+        for box, c in zip(spec["boxes"], spec["gt_classes"]):
+            det = np.concatenate([box, [0.99]]).astype(np.float32)
+            all_boxes[int(c)][i] = np.vstack([all_boxes[int(c)][i], det])
+    return all_boxes
+
+
+def test_reeval_roundtrip(tmp_path):
+    cfg = generate_config("tiny", "synthetic")
+    cfg = cfg.replace_in("dataset", root_path=str(tmp_path))
+    ds = SyntheticDataset("test", str(tmp_path), "", num_images=8,
+                          num_classes=cfg.dataset.num_classes)
+    dets = tmp_path / "dets.pkl"
+    with open(dets, "wb") as f:
+        pickle.dump({"all_boxes": _perfect_dets(ds),
+                     "classes": ds.classes}, f)
+    results = reeval(cfg, str(dets), dataset_kw={"num_images": 8})
+    assert results["mAP"] > 0.99
+
+
+def test_reeval_rejects_wrong_classes(tmp_path):
+    cfg = generate_config("tiny", "synthetic")
+    cfg = cfg.replace_in("dataset", root_path=str(tmp_path))
+    ds = SyntheticDataset("test", str(tmp_path), "", num_images=8,
+                          num_classes=cfg.dataset.num_classes)
+    dets = tmp_path / "dets.pkl"
+    with open(dets, "wb") as f:
+        pickle.dump({"all_boxes": _perfect_dets(ds),
+                     "classes": ["__background__", "cat"]}, f)
+    with pytest.raises(ValueError, match="classes"):
+        reeval(cfg, str(dets), dataset_kw={"num_images": 8})

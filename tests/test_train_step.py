@@ -168,3 +168,24 @@ def test_overfit_single_batch():
     assert last["loss"] < first["loss"] * 0.7, (first, last)
     assert last["rpn_acc"] >= 0.9, (first, last)
     assert last["rcnn_acc"] >= 0.8, (first, last)
+
+
+def test_lr_schedule_warmup_and_decay():
+    """Linear warmup ramps warmup_lr -> base_lr, then step decay applies at
+    epoch boundaries counted from global step 0 (ref
+    WarmupMultiFactorScheduler semantics)."""
+    import numpy as np
+
+    from mx_rcnn_tpu.core.optim import lr_schedule
+
+    sched = lr_schedule(0.01, (2,), steps_per_epoch=100, factor=0.1,
+                        warmup_step=50, warmup_lr=0.001)
+    np.testing.assert_allclose(float(sched(0)), 0.001)
+    np.testing.assert_allclose(float(sched(25)), 0.0055, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(50)), 0.01, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(199)), 0.01, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(200)), 0.001, rtol=1e-6)
+    # warmup off: plain step decay
+    plain = lr_schedule(0.01, (2,), steps_per_epoch=100, factor=0.1)
+    np.testing.assert_allclose(float(plain(0)), 0.01, rtol=1e-6)
+    np.testing.assert_allclose(float(plain(200)), 0.001, rtol=1e-6)
