@@ -92,8 +92,10 @@ def fit(
 ) -> TrainState:
     """Run ``begin_epoch .. num_epochs`` epochs; checkpoint per epoch.
 
-    ``mesh``: a 1-D ``jax.sharding.Mesh`` enables data-parallel SPMD (the
-    kvstore='device' replacement); None = single-device jit.
+    ``mesh``: a ``jax.sharding.Mesh`` (1-D ``('data',)`` or hierarchical
+    ``('dcn', 'ici')`` — see ``parallel.dp.device_mesh``) enables
+    data-parallel SPMD (the kvstore='device' replacement); None =
+    single-device jit.
     ``mode``: 'e2e' | 'rpn' | 'rcnn' (alternate-training stages).
     ``key`` is the base RNG; the step folds in ``state.step`` so resuming
     from a checkpoint replays the identical sample stream.

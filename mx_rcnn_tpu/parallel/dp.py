@@ -4,7 +4,9 @@ Replaces MXNet ``kvstore='device'`` (ref ``train_end2end.py`` passes the
 ctx list + kvstore into ``MutableModule.fit``; MXNet pushes/pulls each
 gradient array through the KVStore).  Here the whole step — forward,
 backward, ``lax.pmean`` gradient sync over ICI, SGD update — is one XLA
-program per device, built with ``jax.shard_map`` over a 1-D ``'data'`` mesh:
+program per device, built with ``jax.shard_map`` over a 1-D ``('data',)``
+mesh (single host/slice) or a 2-D ``('dcn', 'ici')`` mesh (multi-host, see
+:func:`device_mesh`):
 
 * batch leaves are sharded on their leading (image) axis,
 * params / optimizer state are replicated (every device applies the same
@@ -32,13 +34,40 @@ from mx_rcnn_tpu.models.faster_rcnn import FasterRCNN
 
 
 def device_mesh(n_devices: Optional[int] = None,
-                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """1-D data-parallel mesh over the first ``n_devices`` devices."""
+                devices: Optional[Sequence[jax.Device]] = None,
+                dcn_size: int = 1) -> Mesh:
+    """Data-parallel mesh over the first ``n_devices`` devices.
+
+    ``dcn_size=1`` (single host/slice): 1-D ``('data',)`` mesh — gradient
+    pmean rides ICI only.
+
+    ``dcn_size>1`` (multi-host/multi-slice): 2-D ``('dcn', 'ici')`` mesh
+    with the slow inter-host axis OUTERMOST, so XLA decomposes the gradient
+    all-reduce hierarchically — reduce-scatter/all-gather inside each slice
+    over ICI, then one small cross-slice all-reduce over DCN — instead of a
+    flat ring across the slow links.  This is the scaling analog of the
+    reference's unused ``kvstore='dist_sync'`` parameter server (SURVEY.md
+    §5.8), expressed as mesh axes instead of a server process.  On a real
+    multi-host deployment call ``jax.distributed.initialize()`` first and
+    pass ``jax.devices()`` (globally ordered host-major, which matches the
+    host-outermost reshape here).
+    """
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
-    return Mesh(np.asarray(devices), ("data",))
+    devices = np.asarray(devices)
+    if dcn_size <= 1:
+        return Mesh(devices, ("data",))
+    if len(devices) % dcn_size != 0:
+        raise ValueError(
+            f"{len(devices)} devices not divisible by dcn_size={dcn_size}")
+    return Mesh(devices.reshape(dcn_size, -1), ("dcn", "ici"))
+
+
+def data_axes(mesh: Mesh):
+    """The mesh axis name(s) the batch is sharded (and grads reduced) over."""
+    return mesh.axis_names if len(mesh.axis_names) > 1 else mesh.axis_names[0]
 
 
 def replicate(tree, mesh: Mesh):
@@ -49,7 +78,7 @@ def replicate(tree, mesh: Mesh):
 
 def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
     """Shard every batch leaf along its leading (image) axis."""
-    sharding = NamedSharding(mesh, P("data"))
+    sharding = NamedSharding(mesh, P(data_axes(mesh)))
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 
@@ -59,19 +88,25 @@ def make_dp_train_step(model: FasterRCNN, cfg: Config, tx, mesh: Mesh,
 
     Takes (replicated state, sharded batch, replicated key); returns
     (replicated state, replicated metrics).  Gradient sync is the
-    ``lax.pmean('data')`` inside ``core.train.make_train_step``.
+    ``lax.pmean`` over ALL of the mesh's axes (``'data'``, or
+    ``('dcn', 'ici')`` for a hierarchical mesh) inside
+    ``core.train.make_train_step``.
     """
-    base = make_train_step(model, cfg, tx, axis_name="data", mode=mode)
+    axes = data_axes(mesh)
+    base = make_train_step(model, cfg, tx, axis_name=axes, mode=mode)
 
     def shard_fn(state: TrainState, batch: Batch, key: jax.Array):
-        # decorrelate per-image sampling RNG across mesh positions
-        key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+        # decorrelate per-image sampling RNG across mesh positions; for a
+        # 2-D (dcn, ici) mesh axis_index over both axes is the linearized
+        # position, so an N-device run gives identical per-image keys
+        # regardless of the mesh factorization
+        key = jax.random.fold_in(key, jax.lax.axis_index(axes))
         return base(state, batch, key)
 
     sharded = jax.shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(P(), P("data"), P()),
+        in_specs=(P(), P(axes), P()),
         out_specs=(P(), P()),
         check_vma=False,  # RNG fold_in of axis_index is deliberately varying
     )

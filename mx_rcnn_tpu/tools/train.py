@@ -34,7 +34,7 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
               pretrained: str = None, pretrained_epoch: int = 0,
               roidb=None, dataset_kw: dict = None,
               frozen_prefixes=None, mode: str = "e2e", proposals=None,
-              init_from=None, profile_dir: str = None):
+              init_from=None, profile_dir: str = None, dcn_size: int = 1):
     """Train; returns the final TrainState.
 
     ``mode``: 'e2e' | 'rpn' | 'rcnn' — the alternate-training stage drivers
@@ -94,7 +94,12 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
     if num_devices > 1:
         from mx_rcnn_tpu.parallel.dp import device_mesh
 
-        mesh = device_mesh(num_devices)
+        mesh = device_mesh(num_devices, dcn_size=dcn_size)
+    elif dcn_size > 1:
+        raise ValueError(
+            f"dcn_size={dcn_size} requires num_devices > 1 (got "
+            f"{num_devices}) — the (dcn, ici) mesh only exists in "
+            "multi-device training")
     state = fit(model, cfg, state, tx, loader, end_epoch, key,
                 begin_epoch=begin_epoch, prefix=prefix, frequent=frequent,
                 mesh=mesh, mode=mode, profile_dir=profile_dir)
@@ -126,6 +131,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="images per device (ref BATCH_IMAGES)")
     p.add_argument("--num_devices", type=int, default=1,
                    help="data-parallel devices (ref --gpus)")
+    p.add_argument("--dcn_size", type=int, default=1,
+                   help="hosts/slices: >1 builds a (dcn, ici) mesh with "
+                        "hierarchical gradient all-reduce (multi-host DP)")
     p.add_argument("--no_flip", action="store_true")
     p.add_argument("--no_shuffle", action="store_true")
     p.add_argument("--resume", action="store_true",
@@ -167,7 +175,7 @@ def main(argv=None):
               num_devices=args.num_devices, frequent=args.frequent,
               seed=args.seed, pretrained=args.pretrained,
               pretrained_epoch=args.pretrained_epoch,
-              profile_dir=args.profile_dir)
+              profile_dir=args.profile_dir, dcn_size=args.dcn_size)
 
 
 if __name__ == "__main__":
