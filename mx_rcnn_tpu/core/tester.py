@@ -31,17 +31,41 @@ from mx_rcnn_tpu.ops.nms import nms_mask
 
 class Predictor:
     """Jit-compiled test-mode forward, cached per input shape
-    (the XLA analog of MutableModule's rebinding-on-shape-change)."""
+    (the XLA analog of MutableModule's rebinding-on-shape-change).
 
-    def __init__(self, model: FasterRCNN, variables, cfg: Config):
+    ``mesh``: optional 1-D data mesh for multi-chip evaluation (beyond the
+    reference, whose eval is single-GPU): the batch axis is sharded over
+    the mesh and GSPMD parallelizes the whole test-mode forward; short
+    batches pad to a mesh multiple and the pad rows are dropped on fetch.
+    """
+
+    def __init__(self, model: FasterRCNN, variables, cfg: Config, mesh=None):
         self.model = model
         self.variables = variables
         self.cfg = cfg
+        self.mesh = mesh
         self._fns: Dict[Tuple[int, ...], callable] = {}
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from mx_rcnn_tpu.parallel.dp import data_axes, replicate
+
+            self._batch_sharding = NamedSharding(mesh, P(data_axes(mesh)))
+            self.variables = replicate(variables, mesh)
 
     def raw(self, images: np.ndarray, im_info: np.ndarray):
         """Forward pass returning DEVICE arrays (no host sync) — the eval
-        loop feeds these straight into the jitted postprocess."""
+        loop feeds these straight into the jitted postprocess.  Outputs
+        cover exactly the input rows (mesh padding is stripped)."""
+        n = images.shape[0]
+        if self.mesh is not None:
+            pad = (-n) % self.mesh.size
+            if pad:
+                images = np.concatenate(
+                    [images, np.zeros((pad,) + images.shape[1:],
+                                      images.dtype)])
+                im_info = np.concatenate(
+                    [im_info, np.ones((pad, 3), im_info.dtype)])
         shape = tuple(images.shape)
         if shape not in self._fns:
             model = self.model
@@ -51,8 +75,20 @@ class Predictor:
                 return model.apply(variables, images, im_info)
 
             self._fns[shape] = fn
-        return self._fns[shape](
-            self.variables, jnp.asarray(images), jnp.asarray(im_info))
+        if self.mesh is not None:
+            # device_put the host arrays straight into their shards — going
+            # through jnp.asarray first would commit the whole batch to
+            # device 0 and transfer it twice
+            images = jax.device_put(np.asarray(images), self._batch_sharding)
+            im_info = jax.device_put(np.asarray(im_info),
+                                     self._batch_sharding)
+        else:
+            images = jnp.asarray(images)
+            im_info = jnp.asarray(im_info)
+        out = self._fns[shape](self.variables, images, im_info)
+        if self.mesh is not None and out[0].shape[0] != n:
+            out = tuple(o[:n] for o in out)
+        return out
 
     def __call__(self, images: np.ndarray, im_info: np.ndarray):
         rois, roi_valid, cls_prob, deltas = self.raw(images, im_info)

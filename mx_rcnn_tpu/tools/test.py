@@ -23,16 +23,35 @@ logger = logging.getLogger("mx_rcnn_tpu")
 def test_rcnn(cfg: Config, *, prefix: str, epoch: int,
               image_set: str = None, out_dir: str = None,
               verbose: bool = True, dataset_kw: dict = None,
-              save_dets: str = None) -> Dict[str, float]:
+              save_dets: str = None, num_devices: int = 1
+              ) -> Dict[str, float]:
     """Evaluate checkpoint ``prefix``@``epoch``; returns the metric dict
-    (includes ``mAP`` for VOC-style evaluators)."""
+    (includes ``mAP`` for VOC-style evaluators).
+
+    ``num_devices > 1`` shards the eval batch over a data mesh (multi-chip
+    evaluation — the reference evals on a single GPU).
+    """
     imdb, roidb = load_gt_roidb(cfg, image_set=image_set, training=False,
                                 **(dataset_kw or {}))
-    loader = TestLoader(roidb, cfg)
+    mesh = None
+    if num_devices > 1:
+        import jax
+
+        from mx_rcnn_tpu.parallel.dp import device_mesh
+
+        available = len(jax.devices())
+        if num_devices > available:
+            raise ValueError(
+                f"--num_devices {num_devices} but only {available} "
+                f"device(s) available")
+        mesh = device_mesh(num_devices)
+    loader = TestLoader(roidb, cfg,
+                        batch_images=cfg.test.batch_images * num_devices)
     model = build_model(cfg)
     params, batch_stats = load_param(prefix, epoch)
     predictor = Predictor(
-        model, {"params": params, "batch_stats": batch_stats}, cfg)
+        model, {"params": params, "batch_stats": batch_stats}, cfg,
+        mesh=mesh)
     results = pred_eval(predictor, loader, imdb, cfg, out_dir=out_dir,
                         verbose=verbose, save_dets=save_dets)
     for k, v in sorted(results.items()):
@@ -59,6 +78,8 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="write detection files here (VOC comp4 / COCO json)")
     p.add_argument("--save_dets", default=None,
                    help="pickle raw detections here for tools/reeval.py")
+    p.add_argument("--num_devices", type=int, default=1,
+                   help="shard eval batches over this many devices")
     return p.parse_args(argv)
 
 
@@ -74,7 +95,7 @@ def main(argv=None):
     cfg = generate_config(args.network, args.dataset, **overrides)
     test_rcnn(cfg, prefix=args.prefix, epoch=args.epoch,
               image_set=args.image_set, out_dir=args.out_dir,
-              save_dets=args.save_dets)
+              save_dets=args.save_dets, num_devices=args.num_devices)
 
 
 if __name__ == "__main__":
