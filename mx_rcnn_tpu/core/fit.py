@@ -23,7 +23,8 @@ import numpy as np
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.core.train import Batch, TrainState, make_train_step
 from mx_rcnn_tpu.models.faster_rcnn import FasterRCNN
-from mx_rcnn_tpu.utils.checkpoint import save_checkpoint
+from mx_rcnn_tpu.utils.checkpoint import (clear_interrupt, save_checkpoint,
+                                          save_interrupt)
 
 logger = logging.getLogger("mx_rcnn_tpu")
 
@@ -89,6 +90,7 @@ def fit(
     mode: str = "e2e",
     epoch_end_callback: Optional[Callable[[int, TrainState], None]] = None,
     profile_dir: Optional[str] = None,
+    stop_flag: Optional[Callable[[], bool]] = None,
 ) -> TrainState:
     """Run ``begin_epoch .. num_epochs`` epochs; checkpoint per epoch.
 
@@ -102,6 +104,14 @@ def fit(
     ``profile_dir``: capture a ``jax.profiler`` trace of a few early steps
     (after compile warm-up) into this directory for tensorboard inspection;
     the coarse per-stage breakdown lives in ``tools/profile_step.py``.
+    ``stop_flag``: polled after every step; when it returns True the loop
+    saves a mid-epoch interrupt checkpoint (``<prefix>-interrupt.ckpt``)
+    and returns — the preemption path (SIGTERM on preemptible TPUs).
+    Mid-epoch RESUME is driven by ``state.step`` alone: if the incoming
+    state is ``skip`` steps past ``begin_epoch``'s start, the first epoch
+    skips its first ``skip`` batches; the deterministic per-epoch shuffle
+    (``set_epoch``) plus the step-folded RNG make the continued run
+    bit-identical to an uninterrupted one.
     """
     frequent = cfg.default.frequent if frequent is None else frequent
     if mesh is not None and mesh.size > 1:
@@ -122,30 +132,70 @@ def fit(
 
     n_dev = mesh.size if mesh is not None else 1
     speedo = Speedometer(cfg.train.batch_images * n_dev, frequent)
+    steps_per_epoch = len(train_loader)
+    done_steps = int(jax.device_get(state.step))
     for epoch in range(begin_epoch, num_epochs):
         if hasattr(train_loader, "set_epoch"):
             train_loader.set_epoch(epoch)  # resume-exact shuffle order
+        # mid-epoch (preemption) resume: skip batches the restored state
+        # already consumed; the deterministic shuffle replays the same order
+        skip = 0
+        if epoch == begin_epoch and steps_per_epoch:
+            skip = min(max(done_steps - epoch * steps_per_epoch, 0),
+                       steps_per_epoch)
+            if skip:
+                logger.info("Epoch[%d] resuming mid-epoch: skipping %d "
+                            "consumed batches", epoch, skip)
         speedo.reset()
         window: List[Dict] = []
         epoch_metrics: List[Dict] = []
         t0 = time.perf_counter()
-        nbatch = 0
+        nbatch = skip
         tracing = False
-        for batch in train_loader:
-            # trace steps [2, 5) of the first epoch: step 0/1 carry compile
+        stop_requested = False
+        loader_skips = hasattr(train_loader, "skip_next_batches")
+        if skip and loader_skips:
+            train_loader.skip_next_batches(skip)  # free: trims the order list
+        batch_iter = iter(train_loader)
+        if skip and not loader_skips:
+            for _ in range(skip):  # fallback: decode-and-discard
+                next(batch_iter, None)
+        for batch in batch_iter:
+            # trace steps [skip+2, skip+5) of the first epoch: the first two
+            # executed steps carry compile
             if (profile_dir is not None and epoch == begin_epoch
-                    and nbatch == 2):
+                    and nbatch == skip + 2):
                 jax.profiler.start_trace(profile_dir)
                 tracing = True
                 logger.info("profiler trace started -> %s", profile_dir)
             state, metrics = run_step(state, batch)
             window.append(metrics)
             nbatch += 1
-            if tracing and nbatch >= 5:
+            if tracing and nbatch >= skip + 5:
                 jax.block_until_ready(metrics)
                 jax.profiler.stop_trace()
                 tracing = False
                 logger.info("profiler trace written to %s", profile_dir)
+            if stop_flag is not None and stop_flag():
+                stop_requested = True
+                # mid-epoch: save the step-exact interrupt state and leave.
+                # On the epoch's LAST batch, fall through instead — the
+                # normal epoch end writes the (superseding) epoch checkpoint
+                # and the run stops cleanly at the boundary.
+                if nbatch < steps_per_epoch:
+                    if tracing:
+                        jax.profiler.stop_trace()
+                    if prefix is not None:
+                        path = save_interrupt(prefix, state, steps_per_epoch)
+                        logger.info(
+                            "stop requested: saved interrupt checkpoint to "
+                            '"%s" (step %d) — rerun with --resume to '
+                            "continue", path,
+                            int(jax.device_get(state.step)))
+                    else:
+                        logger.info(
+                            "stop requested: no prefix, state not saved")
+                    return state
             if nbatch % frequent == 0:
                 avg = _mean_metrics(window)
                 epoch_metrics.append(avg)
@@ -169,6 +219,11 @@ def fit(
         if prefix is not None:
             path = save_checkpoint(prefix, epoch + 1, state)
             logger.info('Epoch[%d] Saved checkpoint to "%s"', epoch, path)
+            clear_interrupt(prefix)  # the epoch checkpoint supersedes it
         if epoch_end_callback is not None:
             epoch_end_callback(epoch, state)
+        if stop_requested:
+            logger.info("stop requested at epoch boundary — stopping after "
+                        "epoch %d", epoch)
+            return state
     return state

@@ -147,8 +147,15 @@ def _sorted_survivors(
     if _resolve_backend(backend, k + pad, t) == "pallas":
         from mx_rcnn_tpu.ops.nms_pallas import suppression_sweep_pallas
 
+        # the kernel's tile is capped at 128 independent of the padding
+        # tile: at t=256 the (T, K) IoU slab alone is ~12.3 MB for the
+        # production K=12032 and compiles within 48 KB of the 16 MB scoped
+        # VMEM limit in some surrounding-graph contexts (observed under
+        # jvp(vmap(...))); 128 halves the slab at the same total work.
+        # Greedy NMS results are tile-size-invariant (exact sweep).
+        tp = 128 if t % 128 == 0 else t
         keep = suppression_sweep_pallas(
-            boxes[order], alive0, iou_threshold, t,
+            boxes[order], alive0, iou_threshold, tp,
             interpret=jax.default_backend() != "tpu")
     else:
         keep = _suppression_sweep(boxes[order], alive0, iou_threshold, t)

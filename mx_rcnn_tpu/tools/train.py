@@ -34,7 +34,8 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
               pretrained: str = None, pretrained_epoch: int = 0,
               roidb=None, dataset_kw: dict = None,
               frozen_prefixes=None, mode: str = "e2e", proposals=None,
-              init_from=None, profile_dir: str = None, dcn_size: int = 1):
+              init_from=None, profile_dir: str = None, dcn_size: int = 1,
+              resume: bool = False, stop_flag=None):
     """Train; returns the final TrainState.
 
     ``mode``: 'e2e' | 'rpn' | 'rcnn' — the alternate-training stage drivers
@@ -45,6 +46,10 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
     batch_stats from (stage chaining; optimizer state starts fresh).
     ``roidb`` may be injected (the alternate driver does); when None it is
     loaded from ``cfg.dataset``.
+    ``resume``: restore the newest state under ``prefix`` — a SIGTERM
+    interrupt checkpoint (mid-epoch, step-exact) if present, else the
+    highest epoch checkpoint.  ``stop_flag``: polled per step; True ⇒ save
+    an interrupt checkpoint and return (see ``core.fit.fit``).
     """
     if end_epoch is None:
         end_epoch = cfg.default.e2e_epoch
@@ -86,7 +91,41 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
         p, s = load_param(*init_from)
         state = state._replace(params=p, batch_stats=s)
         logger.info("initialized params from %s epoch %d", *init_from)
-    if begin_epoch > 0:
+    if resume and begin_epoch == 0:
+        # auto-resume: a SIGTERM interrupt checkpoint (step-exact) wins over
+        # epoch checkpoints; an explicit --begin_epoch bypasses this and
+        # falls through to the loud restore_state below (missing file ⇒
+        # FileNotFoundError, never a silent from-scratch run)
+        import os
+
+        from mx_rcnn_tpu.utils.checkpoint import (interrupt_path,
+                                                  latest_checkpoint,
+                                                  restore_interrupt)
+
+        if os.path.exists(interrupt_path(prefix)):
+            state, saved_spe = restore_interrupt(state, prefix)
+            if saved_spe is not None and saved_spe != steps_per_epoch:
+                raise ValueError(
+                    f"interrupt checkpoint was written with "
+                    f"{saved_spe} steps/epoch but this run has "
+                    f"{steps_per_epoch} (different batch size, device "
+                    f"count, or dataset) — step-exact resume is impossible; "
+                    f"delete {interrupt_path(prefix)} to resume from the "
+                    f"last epoch checkpoint instead")
+            step = int(state.step)
+            begin_epoch = step // steps_per_epoch
+            logger.info("resumed mid-epoch from %s (step %d → epoch %d)",
+                        interrupt_path(prefix), step, begin_epoch)
+        else:
+            found = latest_checkpoint(prefix)
+            if found:
+                begin_epoch = found[0]
+                state = restore_state(state, prefix, begin_epoch)
+                logger.info("resumed from %s epoch %d", prefix, begin_epoch)
+            else:
+                logger.info("--resume: nothing under %s, starting fresh",
+                            prefix)
+    elif begin_epoch > 0:
         state = restore_state(state, prefix, begin_epoch)
         logger.info("resumed from %s epoch %d", prefix, begin_epoch)
 
@@ -102,7 +141,8 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
             "multi-device training")
     state = fit(model, cfg, state, tx, loader, end_epoch, key,
                 begin_epoch=begin_epoch, prefix=prefix, frequent=frequent,
-                mesh=mesh, mode=mode, profile_dir=profile_dir)
+                mesh=mesh, mode=mode, profile_dir=profile_dir,
+                stop_flag=stop_flag)
     return state
 
 
@@ -137,7 +177,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--no_flip", action="store_true")
     p.add_argument("--no_shuffle", action="store_true")
     p.add_argument("--resume", action="store_true",
-                   help="resume from the latest checkpoint under --prefix")
+                   help="resume from the newest state under --prefix: a "
+                        "SIGTERM interrupt checkpoint (step-exact) if "
+                        "present, else the highest epoch checkpoint")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--profile_dir", default=None,
                    help="capture a jax.profiler trace of early steps here")
@@ -163,19 +205,28 @@ def main(argv=None):
         overrides["train__shuffle"] = False
     cfg = generate_config(args.network, args.dataset, **overrides)
 
-    begin_epoch = args.begin_epoch
-    if args.resume and begin_epoch == 0:
-        from mx_rcnn_tpu.utils.checkpoint import latest_checkpoint
+    # graceful preemption: first SIGTERM finishes the in-flight step, saves
+    # a step-exact interrupt checkpoint and exits; --resume picks it up
+    import signal
 
-        found = latest_checkpoint(args.prefix)
-        if found:
-            begin_epoch = found[0]
-    train_net(cfg, prefix=args.prefix, begin_epoch=begin_epoch,
+    stop = {"flag": False}
+
+    def _on_sigterm(signum, frame):
+        logger.info("SIGTERM received — checkpointing and stopping")
+        stop["flag"] = True
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:  # not the main thread (embedded use) — no handler
+        pass
+
+    train_net(cfg, prefix=args.prefix, begin_epoch=args.begin_epoch,
               end_epoch=args.end_epoch, lr=args.lr, lr_step=args.lr_step,
               num_devices=args.num_devices, frequent=args.frequent,
               seed=args.seed, pretrained=args.pretrained,
               pretrained_epoch=args.pretrained_epoch,
-              profile_dir=args.profile_dir, dcn_size=args.dcn_size)
+              profile_dir=args.profile_dir, dcn_size=args.dcn_size,
+              resume=args.resume, stop_flag=lambda: stop["flag"])
 
 
 if __name__ == "__main__":

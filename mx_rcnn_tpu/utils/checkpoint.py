@@ -35,23 +35,37 @@ def checkpoint_path(prefix: str, epoch: int) -> str:
     return f"{prefix}-{epoch:04d}.ckpt"
 
 
+def _atomic_write(path: str, data: bytes) -> str:
+    """Atomic rename write: a crash mid-write can't corrupt an existing
+    file.  Single implementation shared by the epoch and interrupt
+    checkpoints so the write discipline cannot diverge."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+    return path
+
+
+def _atomic_save(path: str, state) -> str:
+    payload = serialization.to_state_dict(jax.device_get(state))
+    return _atomic_write(path, serialization.msgpack_serialize(payload))
+
+
+def _restore_file(path: str, template_state):
+    with open(path, "rb") as f:
+        raw = serialization.msgpack_restore(f.read())
+    return serialization.from_state_dict(template_state, raw)
+
+
 def save_checkpoint(prefix: str, epoch: int, state) -> str:
     """Serialize a full TrainState (params, batch_stats, opt_state, step).
 
     Ref ``do_checkpoint`` epoch_end_callback; returns the written path.
     """
-    path = checkpoint_path(prefix, epoch)
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    state = jax.device_get(state)
-    payload = serialization.to_state_dict(state)
-    data = serialization.msgpack_serialize(payload)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-    os.replace(tmp, path)  # atomic: a crash mid-write can't corrupt the epoch
-    return path
+    return _atomic_save(checkpoint_path(prefix, epoch), state)
 
 
 def load_checkpoint(prefix: str, epoch: int) -> Dict[str, Any]:
@@ -66,8 +80,7 @@ def restore_state(template_state, prefix: str, epoch: int):
 
     Ref analog: ``load_param`` + ``begin_epoch=N`` resume in train_net.
     """
-    raw = load_checkpoint(prefix, epoch)
-    return serialization.from_state_dict(template_state, raw)
+    return _restore_file(checkpoint_path(prefix, epoch), template_state)
 
 
 def load_param(prefix: str, epoch: int) -> Tuple[Dict, Dict]:
@@ -75,6 +88,53 @@ def load_param(prefix: str, epoch: int) -> Tuple[Dict, Dict]:
     (ref ``load_param(prefix, epoch)`` → arg_params, aux_params)."""
     raw = load_checkpoint(prefix, epoch)
     return raw["params"], raw.get("batch_stats", {})
+
+
+def interrupt_path(prefix: str) -> str:
+    """Checkpoint written on SIGTERM (preemption): full TrainState
+    mid-epoch.  No reference equivalent — the reference dies on preemption
+    and restarts at the last epoch boundary (SURVEY.md §5.3); on TPU,
+    preemptible capacity makes step-granular resume a first-class need."""
+    return f"{prefix}-interrupt.ckpt"
+
+
+def save_interrupt(prefix: str, state, steps_per_epoch: int = None) -> str:
+    """Atomically save a mid-epoch TrainState for preemption resume.
+
+    ``steps_per_epoch`` is recorded alongside the state: mid-epoch resume
+    maps ``state.step`` back to (epoch, consumed batches), which is only
+    valid if the resuming run has the SAME batches-per-epoch (batch size,
+    device count, dataset); the restore validates it loudly.
+    """
+    payload = {
+        "state": serialization.to_state_dict(jax.device_get(state)),
+        "steps_per_epoch": steps_per_epoch,
+    }
+    return _atomic_write(interrupt_path(prefix),
+                         serialization.msgpack_serialize(payload))
+
+
+def restore_interrupt(template_state, prefix: str):
+    """Restore the SIGTERM checkpoint; returns (state, steps_per_epoch).
+
+    ``steps_per_epoch`` is None for interrupt files that predate its
+    recording."""
+    with open(interrupt_path(prefix), "rb") as f:
+        raw = serialization.msgpack_restore(f.read())
+    if isinstance(raw, dict) and "state" in raw and "steps_per_epoch" in raw:
+        state = serialization.from_state_dict(template_state, raw["state"])
+        spe = raw["steps_per_epoch"]
+        return state, (int(spe) if spe is not None else None)
+    return serialization.from_state_dict(template_state, raw), None
+
+
+def clear_interrupt(prefix: str) -> None:
+    """Drop a stale interrupt checkpoint (called once training has
+    progressed past it — an epoch checkpoint now supersedes it)."""
+    try:
+        os.unlink(interrupt_path(prefix))
+    except FileNotFoundError:
+        pass
 
 
 def latest_checkpoint(prefix: str, max_epoch: int = 1000

@@ -182,3 +182,31 @@ def test_prefetch_loader_identical_batches(tmp_path):
         assert i1 == i2
         np.testing.assert_array_equal(s1, s2)
         np.testing.assert_array_equal(b1.images, b2.images)
+
+
+def test_skip_next_batches(tmp_path):
+    """skip_next_batches trims the next iteration's batch order (preemption
+    resume) without touching later epochs."""
+    cfg = generate_config("tiny", "PascalVOC")
+    cfg = cfg.replace_in("bucket", shapes=((128, 160), (160, 128)),
+                         scale=120, max_size=160)
+    cfg = cfg.replace_in("train", max_gt_boxes=8)
+    ds = SyntheticDataset("train", str(tmp_path), "", num_images=12,
+                          image_size=(96, 128))
+    roidb = ds.gt_roidb()
+    ref = AnchorLoader(roidb, cfg, batch_images=2, shuffle=True, seed=5,
+                       num_workers=0)
+    cut = AnchorLoader(roidb, cfg, batch_images=2, shuffle=True, seed=5,
+                       num_workers=0)
+    ref.set_epoch(2)
+    cut.set_epoch(2)
+    full = list(ref)
+    cut.skip_next_batches(2)
+    tail = list(cut)
+    assert len(tail) == len(full) - 2
+    for bs, bp in zip(full[2:], tail):
+        np.testing.assert_array_equal(bs.images, bp.images)
+    # skip applies ONCE: the following epoch is complete again
+    ref.set_epoch(3)
+    cut.set_epoch(3)
+    assert len(list(cut)) == len(list(ref))

@@ -79,3 +79,65 @@ def test_resume_continues_training(tmp_path):
     assert os.path.exists(checkpoint_path(prefix, 3))
     steps_per_epoch = 32  # 32 images, batch 1
     assert int(state.step) == 3 * steps_per_epoch
+
+
+def test_sigterm_interrupt_resume_bit_exact(tmp_path):
+    """Preemption path: stop mid-epoch via stop_flag, restore the interrupt
+    checkpoint with --resume semantics, continue — final params must be
+    BIT-IDENTICAL to an uninterrupted run (deterministic shuffle + RNG
+    folded on state.step make mid-epoch resume exact)."""
+    import jax
+
+    from mx_rcnn_tpu.utils.checkpoint import interrupt_path
+
+    cfg = _cfg(tmp_path)
+    kw = dict(end_epoch=2, lr=0.001, dataset_kw=TRAIN_KW, seed=3)
+
+    # uninterrupted reference run
+    ref = train_net(cfg, prefix=str(tmp_path / "m" / "ref"), **kw)
+
+    # interrupted run: stop after 5 steps of epoch 0
+    counter = {"n": 0}
+
+    def stop_after_5():
+        counter["n"] += 1
+        return counter["n"] > 5
+
+    prefix = str(tmp_path / "m" / "pre")
+    train_net(cfg, prefix=prefix, stop_flag=stop_after_5, **kw)
+    assert os.path.exists(interrupt_path(prefix))
+
+    # resume and finish
+    final = train_net(cfg, prefix=prefix, resume=True, **kw)
+    assert not os.path.exists(interrupt_path(prefix))  # superseded
+
+    assert int(final.step) == int(ref.step)
+    for a, b in zip(jax.tree.leaves(ref.params),
+                    jax.tree.leaves(final.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_stop_on_last_batch_of_epoch_writes_epoch_checkpoint(tmp_path):
+    """SIGTERM landing on an epoch's last batch must finish the epoch
+    normally (epoch checkpoint written, no interrupt file) and stop at the
+    boundary — resume then starts cleanly at the next epoch."""
+    from mx_rcnn_tpu.utils.checkpoint import interrupt_path
+
+    cfg = _cfg(tmp_path)
+    prefix = str(tmp_path / "m" / "edge")
+    counter = {"n": 0}
+    # 32 images / batch 1 = 32 steps/epoch; fire exactly on step 32
+    def stop_on_last(spe=32):
+        counter["n"] += 1
+        return counter["n"] >= spe
+
+    state = train_net(cfg, prefix=prefix, stop_flag=stop_on_last,
+                      end_epoch=3, lr=0.001, dataset_kw=TRAIN_KW, seed=1)
+    assert int(state.step) == 32
+    assert os.path.exists(checkpoint_path(prefix, 1))
+    assert not os.path.exists(interrupt_path(prefix))
+    # resume continues from epoch 1 without skipping
+    final = train_net(cfg, prefix=prefix, resume=True, end_epoch=2,
+                      lr=0.001, dataset_kw=TRAIN_KW, seed=1)
+    assert int(final.step) == 64
+    assert os.path.exists(checkpoint_path(prefix, 2))
