@@ -32,3 +32,17 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.RandomState(0)
+
+
+def shrink_tiny_cfg(cfg):
+    """Shared miniature-e2e hyperparameters for the tiny network on a
+    128x160 canvas (used by test_fit_e2e and test_e2e_formats — keep the
+    two e2e suites on one tuning)."""
+    cfg = cfg.replace_in("train", rpn_pre_nms_top_n=1024,
+                         rpn_post_nms_top_n=300, batch_rois=128,
+                         max_gt_boxes=8, flip=False)
+    cfg = cfg.replace_in("test", rpn_pre_nms_top_n=1024,
+                         rpn_post_nms_top_n=100)
+    cfg = cfg.replace_in("bucket", scale=128, max_size=160,
+                         shapes=((128, 160), (160, 128)))
+    return cfg

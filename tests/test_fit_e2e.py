@@ -23,6 +23,7 @@ from mx_rcnn_tpu.config import generate_config
 from mx_rcnn_tpu.tools.test import test_rcnn as eval_rcnn
 from mx_rcnn_tpu.tools.train import train_net
 from mx_rcnn_tpu.utils.checkpoint import checkpoint_path
+from tests.conftest import shrink_tiny_cfg
 
 
 def _cfg(tmp_path):
@@ -32,14 +33,7 @@ def _cfg(tmp_path):
         dataset__dataset_path=str(tmp_path / "synthetic"),
         dataset__num_classes=4,
     )
-    cfg = cfg.replace_in("train", rpn_pre_nms_top_n=1024,
-                         rpn_post_nms_top_n=300, batch_rois=128,
-                         max_gt_boxes=8, flip=False)
-    cfg = cfg.replace_in("test", rpn_pre_nms_top_n=1024,
-                         rpn_post_nms_top_n=100)
-    cfg = cfg.replace_in("bucket", scale=128, max_size=160,
-                         shapes=((128, 160), (160, 128)))
-    return cfg
+    return shrink_tiny_cfg(cfg)
 
 
 TRAIN_KW = dict(num_images=32, image_size=(128, 160), max_objects=3)
