@@ -1,0 +1,48 @@
+"""RPN proposal generation: dump proposals for a trained RPN checkpoint.
+
+Reference: ``rcnn/tools/test_rpn.py`` — runs the RPN over the
+(flip-augmented) train roidb and writes the proposal pkl that
+``train_rcnn.py`` consumes (ref writes ``rpn_data/*.pkl``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from mx_rcnn_tpu.data import load_gt_roidb
+from mx_rcnn_tpu.tools.train_alternate import _dump_proposals
+from mx_rcnn_tpu.tools.train_rpn import stage_config
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    p = argparse.ArgumentParser(
+        description="Generate RPN proposals (ref rcnn/tools/test_rpn.py)")
+    p.add_argument("--network", default="resnet101",
+                   choices=["vgg", "resnet50", "resnet101", "tiny"])
+    p.add_argument("--dataset", default="PascalVOC",
+                   choices=["PascalVOC", "coco", "synthetic"])
+    p.add_argument("--image_set", default=None)
+    p.add_argument("--root_path", default=None)
+    p.add_argument("--dataset_path", default=None)
+    p.add_argument("--prefix", default="model/rpn")
+    p.add_argument("--epoch", type=int, required=True)
+    p.add_argument("--out", required=True, help="output proposal pkl path")
+    p.add_argument("--no_flip", action="store_true")
+    args = p.parse_args(argv)
+    args.batch_images = None  # stage_config compatibility (train-only knob)
+
+    cfg = stage_config(args)
+    # proposals are generated over the TRAIN roidb (flip-augmented unless
+    # --no_flip), mirroring the alternate-training stage 1.5/3.5 dumps —
+    # shared implementation so the pkl format cannot diverge
+    _, roidb = load_gt_roidb(cfg, training=True)
+    _dump_proposals(cfg, roidb, args.prefix, args.epoch, args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Fast R-CNN-only training from precomputed proposals (stages 2/4).
+
+Reference: ``rcnn/tools/train_rcnn.py`` — trains the detection head on
+proposals dumped by ``test_rpn.py`` (here ``tools/test_rpn.py`` writes the
+same pkl this tool reads).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import pickle
+
+from mx_rcnn_tpu.tools.train_rpn import _stage_args, run_stage
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    p = argparse.ArgumentParser(
+        description="Train the Fast R-CNN stage on precomputed proposals "
+                    "(ref rcnn/tools/train_rcnn.py)")
+    _stage_args(p, default_prefix="model/rcnn")
+    p.add_argument("--proposals", required=True,
+                   help="proposal pkl written by tools/test_rpn.py "
+                        "(roidb order, (k, 5) arrays)")
+    args = p.parse_args(argv)
+    with open(args.proposals, "rb") as f:
+        proposals = pickle.load(f)
+    logger.info("loaded proposals for %d images from %s", len(proposals),
+                args.proposals)
+    run_stage(args, mode="rcnn", proposals=proposals)
+
+
+if __name__ == "__main__":
+    main()

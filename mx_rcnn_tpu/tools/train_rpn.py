@@ -1,0 +1,96 @@
+"""RPN-only training entry point (alternate-training stages 1/3).
+
+Reference: ``rcnn/tools/train_rpn.py`` — the standalone stage tool; the
+4-stage driver (``tools/train_alternate.py``) invokes the same machinery
+programmatically.  ``--frozen_shared`` freezes FIXED_PARAMS_SHARED (stage
+3: retrain RPN with the shared convs pinned); ``--init_from`` chains from a
+previous stage checkpoint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.tools.train import train_net
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+
+def _stage_args(p: argparse.ArgumentParser, default_prefix: str) -> None:
+    """CLI surface shared by the RPN/RCNN stage tools."""
+    p.add_argument("--network", default="resnet101",
+                   choices=["vgg", "resnet50", "resnet101", "tiny"])
+    p.add_argument("--dataset", default="PascalVOC",
+                   choices=["PascalVOC", "coco", "synthetic"])
+    p.add_argument("--image_set", default=None)
+    p.add_argument("--root_path", default=None)
+    p.add_argument("--dataset_path", default=None)
+    p.add_argument("--prefix", default=default_prefix)
+    p.add_argument("--pretrained", default=None)
+    p.add_argument("--pretrained_epoch", type=int, default=0)
+    p.add_argument("--init_from", default=None,
+                   help="checkpoint prefix to initialize params from "
+                        "(stage chaining)")
+    p.add_argument("--init_from_epoch", type=int, default=0)
+    p.add_argument("--frozen_shared", action="store_true",
+                   help="freeze FIXED_PARAMS_SHARED (stages 3/4)")
+    p.add_argument("--end_epoch", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--lr_step", default=None)
+    p.add_argument("--frequent", type=int, default=None)
+    p.add_argument("--batch_images", type=int, default=None)
+    p.add_argument("--num_devices", type=int, default=1)
+    p.add_argument("--no_flip", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+
+
+def stage_config(args) -> "Config":  # noqa: F821
+    overrides = {}
+    if args.image_set:
+        overrides["dataset__image_set"] = args.image_set
+    if args.root_path:
+        overrides["dataset__root_path"] = args.root_path
+    if args.dataset_path:
+        overrides["dataset__dataset_path"] = args.dataset_path
+    if args.batch_images:
+        overrides["train__batch_images"] = args.batch_images
+    if args.no_flip:
+        overrides["train__flip"] = False
+    return generate_config(args.network, args.dataset, **overrides)
+
+
+def run_stage(args, mode: str, proposals=None) -> None:
+    cfg = stage_config(args)
+    d = cfg.default
+    end_epoch = args.end_epoch
+    if end_epoch is None:
+        end_epoch = d.rpn_epoch if mode == "rpn" else d.rcnn_epoch
+    lr = args.lr if args.lr is not None else (
+        d.rpn_lr if mode == "rpn" else d.rcnn_lr)
+    lr_step = args.lr_step if args.lr_step is not None else (
+        d.rpn_lr_step if mode == "rpn" else d.rcnn_lr_step)
+    train_net(
+        cfg, mode=mode, prefix=args.prefix, end_epoch=end_epoch, lr=lr,
+        lr_step=lr_step, num_devices=args.num_devices,
+        frequent=args.frequent, seed=args.seed,
+        pretrained=args.pretrained, pretrained_epoch=args.pretrained_epoch,
+        init_from=((args.init_from, args.init_from_epoch)
+                   if args.init_from else None),
+        frozen_prefixes=(cfg.network.fixed_params_shared
+                         if args.frozen_shared else None),
+        proposals=proposals)
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    p = argparse.ArgumentParser(
+        description="Train the RPN stage (ref rcnn/tools/train_rpn.py)")
+    _stage_args(p, default_prefix="model/rpn")
+    run_stage(p.parse_args(argv), mode="rpn")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""Standalone stage CLIs (ref rcnn/tools/train_rpn.py / test_rpn.py /
+train_rcnn.py): the 4-stage pipeline driven tool-by-tool through argparse,
+the way the reference's shell scripts chain them."""
+
+import os
+import pickle
+
+import numpy as np
+
+from mx_rcnn_tpu.tools import test_rpn, train_rcnn, train_rpn
+
+
+def test_stage_clis_chain(tmp_path):
+    root = str(tmp_path / "data")
+    common = ["--network", "tiny", "--dataset", "synthetic",
+              "--root_path", root, "--no_flip"]
+    rpn_prefix = str(tmp_path / "rpn")
+    train_rpn.main(common + ["--prefix", rpn_prefix, "--end_epoch", "1"])
+    assert os.path.exists(rpn_prefix + "-0001.ckpt")
+
+    props = str(tmp_path / "props.pkl")
+    test_rpn.main(common + ["--prefix", rpn_prefix, "--epoch", "1",
+                            "--out", props])
+    with open(props, "rb") as f:
+        proposals = pickle.load(f)
+    assert len(proposals) == 64  # synthetic train set size
+    assert all(np.asarray(p).ndim == 2 and np.asarray(p).shape[1] == 5
+               for p in proposals if len(p))
+
+    rcnn_prefix = str(tmp_path / "rcnn")
+    train_rcnn.main(common + [
+        "--prefix", rcnn_prefix, "--end_epoch", "1",
+        "--proposals", props,
+        "--init_from", rpn_prefix, "--init_from_epoch", "1",
+        "--frozen_shared"])
+    assert os.path.exists(rcnn_prefix + "-0001.ckpt")
