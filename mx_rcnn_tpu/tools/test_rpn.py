@@ -34,8 +34,6 @@ def main(argv=None):
     p.add_argument("--out", required=True, help="output proposal pkl path")
     p.add_argument("--no_flip", action="store_true")
     args = p.parse_args(argv)
-    args.batch_images = None  # stage_config compatibility (train-only knob)
-
     cfg = stage_config(args)
     # proposals are generated over the TRAIN roidb (flip-augmented unless
     # --no_flip), mirroring the alternate-training stage 1.5/3.5 dumps —

@@ -146,6 +146,29 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
     return state
 
 
+def config_from_args(args) -> Config:
+    """Build the config from common dataset/train CLI flags.
+
+    Shared by every training-family CLI (train, train_alternate,
+    train_rpn/train_rcnn/test_rpn); absent attributes are treated as unset
+    so tools only expose the flags that apply to them.
+    """
+    overrides = {}
+    if getattr(args, "image_set", None):
+        overrides["dataset__image_set"] = args.image_set
+    if getattr(args, "root_path", None):
+        overrides["dataset__root_path"] = args.root_path
+    if getattr(args, "dataset_path", None):
+        overrides["dataset__dataset_path"] = args.dataset_path
+    if getattr(args, "batch_images", None):
+        overrides["train__batch_images"] = args.batch_images
+    if getattr(args, "no_flip", False):
+        overrides["train__flip"] = False
+    if getattr(args, "no_shuffle", False):
+        overrides["train__shuffle"] = False
+    return generate_config(args.network, args.dataset, **overrides)
+
+
 def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser(
         description="Train Faster R-CNN end-to-end (ref train_end2end.py)")
@@ -190,20 +213,7 @@ def main(argv=None):
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
     args = parse_args(argv)
-    overrides = {}
-    if args.image_set:
-        overrides["dataset__image_set"] = args.image_set
-    if args.root_path:
-        overrides["dataset__root_path"] = args.root_path
-    if args.dataset_path:
-        overrides["dataset__dataset_path"] = args.dataset_path
-    if args.batch_images:
-        overrides["train__batch_images"] = args.batch_images
-    if args.no_flip:
-        overrides["train__flip"] = False
-    if args.no_shuffle:
-        overrides["train__shuffle"] = False
-    cfg = generate_config(args.network, args.dataset, **overrides)
+    cfg = config_from_args(args)
 
     # graceful preemption: first SIGTERM finishes the in-flight step, saves
     # a step-exact interrupt checkpoint and exits; --resume picks it up

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from mx_rcnn_tpu.config import Config, generate_config
+from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.core.tester import generate_proposals
 from mx_rcnn_tpu.core.train import TrainState
 from mx_rcnn_tpu.data import TestLoader, load_gt_roidb
@@ -159,16 +159,9 @@ def main(argv=None):
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
     args = parse_args(argv)
-    overrides = {}
-    if args.image_set:
-        overrides["dataset__image_set"] = args.image_set
-    if args.root_path:
-        overrides["dataset__root_path"] = args.root_path
-    if args.dataset_path:
-        overrides["dataset__dataset_path"] = args.dataset_path
-    if args.no_flip:
-        overrides["train__flip"] = False
-    cfg = generate_config(args.network, args.dataset, **overrides)
+    from mx_rcnn_tpu.tools.train import config_from_args
+
+    cfg = config_from_args(args)
     alternate_train(cfg, prefix=args.prefix, pretrained=args.pretrained,
                     pretrained_epoch=args.pretrained_epoch,
                     rpn_epoch=args.rpn_epoch, rpn_lr=args.rpn_lr,

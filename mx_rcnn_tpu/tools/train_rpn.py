@@ -12,8 +12,7 @@ from __future__ import annotations
 import argparse
 import logging
 
-from mx_rcnn_tpu.config import generate_config
-from mx_rcnn_tpu.tools.train import train_net
+from mx_rcnn_tpu.tools.train import config_from_args, train_net
 
 logger = logging.getLogger("mx_rcnn_tpu")
 
@@ -46,19 +45,8 @@ def _stage_args(p: argparse.ArgumentParser, default_prefix: str) -> None:
     p.add_argument("--seed", type=int, default=0)
 
 
-def stage_config(args) -> "Config":  # noqa: F821
-    overrides = {}
-    if args.image_set:
-        overrides["dataset__image_set"] = args.image_set
-    if args.root_path:
-        overrides["dataset__root_path"] = args.root_path
-    if args.dataset_path:
-        overrides["dataset__dataset_path"] = args.dataset_path
-    if args.batch_images:
-        overrides["train__batch_images"] = args.batch_images
-    if args.no_flip:
-        overrides["train__flip"] = False
-    return generate_config(args.network, args.dataset, **overrides)
+# shared CLI→config mapping (tolerates tools that omit train-only flags)
+stage_config = config_from_args
 
 
 def run_stage(args, mode: str, proposals=None) -> None:

@@ -1,0 +1,16 @@
+"""Smoke test for the step profiler tool: every ablation stage must trace,
+compile and execute (CPU, tiny network) — the timings themselves are only
+meaningful on real hardware, so this asserts structure, not numbers."""
+
+from mx_rcnn_tpu.tools.profile_step import main
+
+
+def test_profile_step_smoke(capsys):
+    main(["--network", "tiny", "--dataset", "synthetic",
+          "--shape", "128x160", "--batch_images", "1", "--iters", "2"])
+    out = capsys.readouterr().out
+    for label in ("backbone fwd", "proposal (decode+topk+NMS)",
+                  "anchor_target", "proposal_target", "roi_align",
+                  "full loss fwd+bwd (no update)", "optimizer update",
+                  "FULL train step (donated)"):
+        assert label in out, out
