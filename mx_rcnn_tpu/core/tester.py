@@ -138,8 +138,13 @@ def _postprocess_batch(rois, roi_valid, cls_prob, deltas, im_info, scales,
         boxes_c = boxes.reshape(r, c, 4).transpose(1, 0, 2)  # (C, R, 4)
         scores_c = scores.T  # (C, R)
         cand = (scores_c > score_thresh) & valid_i[None, :]
+        # backend pinned to jnp: under this (classes x images) double vmap
+        # the Pallas kernel's batching rule could multiply its VMEM blocks
+        # past the scoped limit, and at eval sizes (a few hundred boxes per
+        # class) the kernel has no advantage anyway
         keep = jax.vmap(
-            lambda b, s, v: nms_mask(b, s, nms_thresh, valid=v)
+            lambda b, s, v: nms_mask(b, s, nms_thresh, valid=v,
+                                     backend="jnp")
         )(boxes_c, scores_c, cand)
         return keep & cand
 

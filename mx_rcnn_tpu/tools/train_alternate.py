@@ -33,7 +33,7 @@ from mx_rcnn_tpu.core.tester import generate_proposals
 from mx_rcnn_tpu.core.train import TrainState
 from mx_rcnn_tpu.data import TestLoader, load_gt_roidb
 from mx_rcnn_tpu.models import build_model
-from mx_rcnn_tpu.tools.train import train_net
+from mx_rcnn_tpu.tools.train import config_from_args, train_net
 from mx_rcnn_tpu.utils.checkpoint import (combine_model, load_param,
                                           save_checkpoint)
 
@@ -159,8 +159,6 @@ def main(argv=None):
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
     args = parse_args(argv)
-    from mx_rcnn_tpu.tools.train import config_from_args
-
     cfg = config_from_args(args)
     alternate_train(cfg, prefix=args.prefix, pretrained=args.pretrained,
                     pretrained_epoch=args.pretrained_epoch,
