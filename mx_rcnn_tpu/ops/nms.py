@@ -33,7 +33,10 @@ import jax.numpy as jnp
 
 from mx_rcnn_tpu.ops.boxes import bbox_overlaps
 
-_NEG = jnp.float32(-1e10)
+# plain float, NOT jnp.float32: a module-level jnp constant would
+# initialize the XLA backend at import time, breaking the
+# jax.distributed.initialize ordering multi-host needs
+_NEG = -1e10
 
 # Suppression-sweep backend: the Pallas kernel (ops/nms_pallas.py) keeps the
 # whole sweep in VMEM; the jnp sweep below is the oracle and the fallback.

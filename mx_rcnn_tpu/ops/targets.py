@@ -38,7 +38,9 @@ import jax.numpy as jnp
 
 from mx_rcnn_tpu.ops.boxes import bbox_overlaps, bbox_transform
 
-_INF = jnp.float32(3.4e38)
+# plain float (module-level jnp constants initialize the backend at
+# import time — see ops/nms.py)
+_INF = 3.4e38
 
 
 def _rank_of_uniform(key: jax.Array, mask: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,83 @@
+"""Multi-process (multi-host) data parallelism helpers.
+
+Reference: MXNet ``kvstore='dist_sync'`` — the reference's parameter-server
+pass-through for multi-machine training (SURVEY.md §5.8; present as a flag,
+never exercised by its scripts).  The TPU-native equivalent is
+``jax.distributed``: every host runs the SAME SPMD program over the global
+``(dcn, ici)`` mesh (``parallel/dp.py — device_mesh``), gradients pmean
+over both axes, and XLA routes the per-slice reduction over ICI and the
+small cross-host exchange over DCN — no parameter server, no separate
+communication library.
+
+The pieces here are the host-boundary glue the single-process path does
+not need: assembling process-local numpy shards into global arrays, and
+replicating host-identical values (states initialized from the same seed)
+across processes.  ``tools/multihost_demo.py`` wires them into a runnable
+two-process demonstration on CPU devices; the same calls serve a real
+multi-host TPU pod (one process per host, ``jax.distributed.initialize``
+with the pod coordinator).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.experimental import multihost_utils
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mx_rcnn_tpu.parallel.dp import data_axes
+
+
+def initialize(coordinator: str, num_processes: int, process_id: int,
+               local_devices: Optional[int] = None) -> None:
+    """``jax.distributed.initialize`` wrapper (one process per host).
+
+    ``local_devices``: with CPU devices, pins the per-process device count
+    (the multi-host-without-a-cluster test rig); on real TPU hosts leave
+    None — the runtime discovers the local chips.
+    """
+    import os
+
+    if local_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{local_devices}").strip()
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def global_mesh(dcn_size: Optional[int] = None) -> Mesh:
+    """Hierarchical mesh over ALL processes' devices; ``dcn_size`` defaults
+    to the process count (one slice per host — jax.devices() orders
+    devices host-major, matching the host-outermost reshape)."""
+    from mx_rcnn_tpu.parallel.dp import device_mesh
+
+    if dcn_size is None:
+        dcn_size = jax.process_count()
+    return device_mesh(dcn_size=dcn_size)
+
+
+def global_batch(batch, mesh: Mesh):
+    """Assemble each process's LOCAL batch shard into global arrays sharded
+    over the mesh's data axes (the multi-host analog of
+    ``dp.shard_batch``).  Every process passes only its own images."""
+    spec = P(data_axes(mesh))
+    return jax.tree.map(
+        lambda x: multihost_utils.host_local_array_to_global_array(
+            np.asarray(x), mesh, spec),
+        batch)
+
+
+def replicate_global(tree, mesh: Mesh):
+    """Replicate host-identical values across every process/device (states
+    initialized from one seed are bit-identical on every host — asserted
+    cheaply via a checksum in the demo)."""
+    return jax.tree.map(
+        lambda x: multihost_utils.host_local_array_to_global_array(
+            np.asarray(x), mesh, P()),
+        tree)
