@@ -56,3 +56,38 @@ def test_pallas_dense_cluster():
     want = nms_mask(boxes, scores, 0.5, tile_size=128, backend="jnp")
     got = nms_mask(boxes, scores, 0.5, tile_size=128, backend="pallas")
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_set_nms_backend_validation():
+    import importlib
+
+    # ops/__init__ re-exports the nms FUNCTION over the module name
+    nms_mod = importlib.import_module("mx_rcnn_tpu.ops.nms")
+
+    before = nms_mod._BACKEND
+    try:
+        with pytest.raises(ValueError, match="unknown NMS backend"):
+            nms_mod.set_nms_backend("cuda")
+        nms_mod.set_nms_backend("jnp")
+        assert nms_mod._BACKEND == "jnp"
+    finally:
+        nms_mod.set_nms_backend(before)
+
+
+def test_resolve_backend_guards(monkeypatch):
+    """Auto selection requires TPU + 128-lane-aligned tiles + a bounded
+    (T, K) VMEM slab; anything else falls back to jnp."""
+    import importlib
+
+    nms_mod = importlib.import_module("mx_rcnn_tpu.ops.nms")
+    monkeypatch.setattr(nms_mod.jax, "default_backend", lambda: "tpu")
+    r = nms_mod._resolve_backend
+    assert r(None, 12032, 256) == "pallas"      # production proposal shape
+    assert r(None, 512, 128) == "pallas"
+    assert r(None, 500, 100) == "jnp"           # tile not lane-aligned
+    assert r(None, 513, 128) == "jnp"           # K not a tile multiple
+    assert r(None, 40000, 256) == "jnp"         # slab over the VMEM guard
+    assert r("jnp", 12032, 256) == "jnp"        # explicit override wins
+    assert r("pallas", 500, 100) == "pallas"    # explicit override wins
+    monkeypatch.setattr(nms_mod.jax, "default_backend", lambda: "cpu")
+    assert r(None, 12032, 256) == "jnp"         # no TPU -> jnp
