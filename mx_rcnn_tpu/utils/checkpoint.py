@@ -180,3 +180,33 @@ def combine_model(params_a: Dict, params_b: Dict,
 def tree_size_bytes(tree) -> int:
     """Total parameter bytes (for logging)."""
     return sum(np.asarray(x).nbytes for x in jax.tree.leaves(tree))
+
+
+# ---- orbax interop ----------------------------------------------------------
+# The native checkpoint format above is a single msgpack file (atomic,
+# dependency-light, bit-exact resume).  These adapters bridge to orbax —
+# the TPU-ecosystem standard (sharded/async saves, cloud storage) — so
+# models move freely between this framework and orbax-based tooling.
+
+
+def export_orbax(prefix: str, epoch: int, out_dir: str) -> str:
+    """Convert the epoch checkpoint ``prefix``@``epoch`` into an orbax
+    checkpoint directory; returns the written path."""
+    import orbax.checkpoint as ocp
+
+    raw = load_checkpoint(prefix, epoch)
+    path = os.path.abspath(out_dir)
+    with ocp.StandardCheckpointer() as ckptr:
+        # idempotent re-export: orbax refuses to overwrite an existing dir
+        ckptr.save(path, raw, force=True)
+    return path
+
+
+def import_orbax(template_state, orbax_dir: str):
+    """Restore a TrainState from an orbax directory written by
+    :func:`export_orbax` (or any orbax save of the same tree)."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        raw = ckptr.restore(os.path.abspath(orbax_dir))
+    return serialization.from_state_dict(template_state, raw)

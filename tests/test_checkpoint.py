@@ -96,3 +96,19 @@ def test_checkpoint_file_is_atomic(tmp_path):
     prefix = str(tmp_path / "m")
     save_checkpoint(prefix, 1, state)
     assert not os.path.exists(checkpoint_path(prefix, 1) + ".tmp")
+
+
+def test_orbax_export_import_roundtrip(tmp_path):
+    """Native checkpoint → orbax directory → TrainState, bit-exact
+    (ecosystem interop; SURVEY §5.4 names orbax as the TPU standard)."""
+    from mx_rcnn_tpu.utils.checkpoint import export_orbax, import_orbax
+
+    cfg, model, tx, state = tiny_setup()
+    prefix = str(tmp_path / "m" / "e2e")
+    save_checkpoint(prefix, 1, state)
+    odir = export_orbax(prefix, 1, str(tmp_path / "orbax_ckpt"))
+    restored = import_orbax(state, odir)
+    assert int(restored.step) == int(state.step)
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
