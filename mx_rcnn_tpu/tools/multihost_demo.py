@@ -86,10 +86,18 @@ def worker(args) -> None:
     print(f"[p{pid}] done", flush=True)
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
 def launch(n: int, steps: int, local_devices: int = 2) -> int:
     """Spawn ``n`` local worker processes; verify every process reports the
     same per-step loss (the gradients were truly synchronized)."""
-    port = 20000 + (os.getpid() % 10000)
+    port = _free_port()
     procs = []
     outs = []
     ok = True
