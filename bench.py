@@ -54,8 +54,9 @@ def main() -> None:
     import jax.numpy as jnp
 
     from mx_rcnn_tpu.config import generate_config
-    from mx_rcnn_tpu.core.train import Batch, make_train_step, setup_training
+    from mx_rcnn_tpu.core.train import make_train_step, setup_training
     from mx_rcnn_tpu.models import build_model
+    from mx_rcnn_tpu.tools.profile_step import make_batch
 
     batch_images = 2
     h, w = 608, 1024
@@ -64,36 +65,21 @@ def main() -> None:
     model = build_model(cfg)
 
     key = jax.random.PRNGKey(0)
-    rng = np.random.RandomState(0)
-    g = cfg.train.max_gt_boxes
-    n_gt = 8  # typical COCO images carry ~7 annotations
-    gt_boxes = np.zeros((batch_images, g, 4), np.float32)
-    gt_classes = np.zeros((batch_images, g), np.int32)
-    gt_valid = np.zeros((batch_images, g), bool)
-    for i in range(batch_images):
-        xy = rng.uniform(0, 500, (n_gt, 2))
-        wh = rng.uniform(60, 300, (n_gt, 2))
-        gt_boxes[i, :n_gt, :2] = xy
-        gt_boxes[i, :n_gt, 2:] = np.minimum(xy + wh, [w - 1, h - 1])
-        gt_classes[i, :n_gt] = rng.randint(1, 81, n_gt)
-        gt_valid[i, :n_gt] = True
-    batch = Batch(
-        images=jnp.asarray(rng.randn(batch_images, h, w, 3), jnp.float32),
-        im_info=jnp.tile(jnp.array([[600.0, 1000.0, 1.0]]), (batch_images, 1)),
-        gt_boxes=jnp.asarray(gt_boxes),
-        gt_classes=jnp.asarray(gt_classes),
-        gt_valid=jnp.asarray(gt_valid),
-    )
+    batch = make_batch(cfg, batch_images, h, w, seed=0)
 
     def fetch(x):
         return np.asarray(x).ravel()[:1]
 
-    # host<->device round-trip floor (tunneled devices: ~100 ms)
+    # host<->device round-trip floor (tunneled devices: ~100 ms); min of a
+    # few probes — a single sample is jittery and would skew the subtraction
     tiny = jax.jit(lambda c: c + 1.0)
     fetch(tiny(jnp.float32(0)))
-    t0 = time.perf_counter()
-    fetch(tiny(jnp.float32(0)))
-    rtt = time.perf_counter() - t0
+    probes = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        fetch(tiny(jnp.float32(0)))
+        probes.append(time.perf_counter() - t0)
+    rtt = min(probes)
     print(f"fetch round-trip: {rtt * 1e3:.1f} ms", file=sys.stderr)
 
     print("initializing model...", file=sys.stderr)

@@ -151,9 +151,16 @@ def bbox_overlaps(boxes: np.ndarray, query: np.ndarray) -> np.ndarray:
 
 def cpu_nms(dets: np.ndarray, thresh: float) -> np.ndarray:
     """Greedy NMS over (n,5) [x1 y1 x2 y2 score]; returns kept indices in
-    descending-score order (ref ``cpu_nms.pyx``)."""
+    descending-score order (ref ``cpu_nms.pyx``).
+
+    Tie-break matches the reference's ``scores.argsort()[::-1]``: among
+    equal scores the HIGHER original index is visited first (deterministic
+    here via a stable sort; the reference's introsort leaves ties
+    platform-defined).  Note the in-graph NMS (``ops/nms.py``) breaks ties
+    lower-index-first, so tied detections may differ across backends.
+    """
     dets = _f32(dets).reshape(-1, 5)
-    order = np.argsort(-dets[:, 4], kind="stable")
+    order = dets[:, 4].argsort(kind="stable")[::-1]
     sorted_dets = np.ascontiguousarray(dets[order])
     n = len(sorted_dets)
     if n == 0:

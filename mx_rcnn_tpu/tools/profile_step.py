@@ -40,8 +40,8 @@ def make_batch(cfg, batch_images, h, w, seed=0):
     gt_classes = np.zeros((batch_images, g), np.int32)
     gt_valid = np.zeros((batch_images, g), bool)
     for i in range(batch_images):
-        xy = rng.uniform(0, 500, (n_gt, 2))
-        wh = rng.uniform(60, 300, (n_gt, 2))
+        xy = rng.uniform(0, [w * 0.8, h * 0.8], (n_gt, 2))
+        wh = rng.uniform(0.05, 0.4, (n_gt, 2)) * [w, h]
         gt_boxes[i, :n_gt, :2] = xy
         gt_boxes[i, :n_gt, 2:] = np.minimum(xy + wh, [w - 1, h - 1])
         gt_classes[i, :n_gt] = rng.randint(1, cfg.dataset.num_classes, n_gt)

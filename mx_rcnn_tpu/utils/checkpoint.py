@@ -189,15 +189,29 @@ def tree_size_bytes(tree) -> int:
 # models move freely between this framework and orbax-based tooling.
 
 
-def export_orbax(prefix: str, epoch: int, out_dir: str) -> str:
+def export_orbax(prefix: str, epoch: int, out_dir: str,
+                 overwrite: bool = False) -> str:
     """Convert the epoch checkpoint ``prefix``@``epoch`` into an orbax
-    checkpoint directory; returns the written path."""
+    checkpoint directory; returns the written path.
+
+    Refuses to clobber a non-empty ``out_dir`` that is not itself a prior
+    orbax export unless ``overwrite=True`` (orbax's ``force`` deletes the
+    target silently, which would eat a mistyped path).
+    """
     import orbax.checkpoint as ocp
 
     raw = load_checkpoint(prefix, epoch)
     path = os.path.abspath(out_dir)
+    if os.path.isdir(path) and os.listdir(path) and not overwrite:
+        is_prior_export = any(
+            os.path.exists(os.path.join(path, marker))
+            for marker in ("_CHECKPOINT_METADATA", "_METADATA"))
+        if not is_prior_export:
+            raise FileExistsError(
+                f"{path} exists, is non-empty, and does not look like an "
+                f"orbax checkpoint; pass overwrite=True to replace it")
     with ocp.StandardCheckpointer() as ckptr:
-        # idempotent re-export: orbax refuses to overwrite an existing dir
+        # force: re-export over a prior checkpoint (or explicit overwrite)
         ckptr.save(path, raw, force=True)
     return path
 

@@ -112,3 +112,27 @@ def test_orbax_export_import_roundtrip(tmp_path):
     for a, b in zip(jax.tree.leaves(state.params),
                     jax.tree.leaves(restored.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_orbax_export_refuses_foreign_dir(tmp_path):
+    """export_orbax must not silently delete a non-checkpoint directory
+    (ADVICE r2): re-export over a prior export is fine, but clobbering an
+    arbitrary non-empty dir requires overwrite=True."""
+    import pytest
+
+    from mx_rcnn_tpu.utils.checkpoint import export_orbax
+
+    cfg, model, tx, state = tiny_setup()
+    prefix = str(tmp_path / "m" / "e2e")
+    save_checkpoint(prefix, 1, state)
+
+    victim = tmp_path / "precious"
+    victim.mkdir()
+    (victim / "data.txt").write_text("do not eat")
+    with pytest.raises(FileExistsError):
+        export_orbax(prefix, 1, str(victim))
+    assert (victim / "data.txt").read_text() == "do not eat"
+
+    # explicit overwrite works, and re-export over a prior export works
+    export_orbax(prefix, 1, str(victim), overwrite=True)
+    export_orbax(prefix, 1, str(victim))

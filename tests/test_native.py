@@ -23,7 +23,8 @@ def _numpy_backend(monkeypatch):
 
 
 def _greedy_nms_oracle(dets, thresh):
-    order = np.argsort(-dets[:, 4], kind="stable")
+    # tie-break matches cpu_nms / ref scores.argsort()[::-1]: higher index first
+    order = dets[:, 4].argsort(kind="stable")[::-1]
     keep, live = [], np.ones(len(dets), bool)
     for i in order:
         if not live[i]:
@@ -72,6 +73,18 @@ def test_cpu_nms_backends_agree(has_native, monkeypatch):
 
 def test_cpu_nms_empty():
     assert native.cpu_nms(np.zeros((0, 5), np.float32), 0.3).size == 0
+
+
+def test_cpu_nms_tie_break_matches_reference(has_native, monkeypatch):
+    """Among equal scores the reference's ``scores.argsort()[::-1]`` visits
+    the HIGHER original index first (ADVICE r2).  Two disjoint boxes with
+    identical scores: both kept, higher index reported first."""
+    dets = np.array([[0, 0, 10, 10, 0.5],
+                     [100, 100, 110, 110, 0.5]], np.float32)
+    np.testing.assert_array_equal(native.cpu_nms(dets, 0.3), [1, 0])
+    if has_native:
+        _numpy_backend(monkeypatch)
+        np.testing.assert_array_equal(native.cpu_nms(dets, 0.3), [1, 0])
 
 
 def test_bbox_overlaps_against_jnp(has_native):
