@@ -166,6 +166,13 @@ class DefaultConfig:
     # SURVEY.md §7 "Hard parts": cv2 decode must overlap device steps)
     num_workers: int = 4
     prefetch: int = 4
+    # ship uint8 batches and normalize on device (ops/normalize.py) —
+    # bit-identical to host normalization, 4x less host bandwidth
+    raw_images: bool = True
+    # decoded-uint8 image cache (data/cache.py): RAM-tier budget in MiB
+    # (0 disables), plus an optional disk tier directory
+    image_cache_mb: int = 2048
+    image_cache_dir: str = ""
 
 
 @dataclass(frozen=True)

@@ -66,7 +66,9 @@ class Predictor:
                                       images.dtype)])
                 im_info = np.concatenate(
                     [im_info, np.ones((pad, 3), im_info.dtype)])
-        shape = tuple(images.shape)
+        # keyed by shape AND dtype: uint8 raw batches and fp32
+        # host-normalized batches compile to different programs
+        shape = (tuple(images.shape), np.dtype(images.dtype).name)
         if shape not in self._fns:
             model = self.model
 
@@ -262,7 +264,8 @@ def generate_proposals(model: FasterRCNN, variables, test_loader, cfg: Config
     pre = cfg.test.proposal_pre_nms_top_n
     post = cfg.test.proposal_post_nms_top_n
     for batch, indices, scales in test_loader:
-        shape = tuple(batch.images.shape)
+        shape = (tuple(batch.images.shape),
+                 np.dtype(batch.images.dtype).name)
         if shape not in fns:
             @jax.jit
             def fn(variables, images, im_info):

@@ -55,8 +55,10 @@ class TrainState(NamedTuple):
 class Batch(NamedTuple):
     """Static-shape training batch (built host-side by the loader).
 
-    images: (N, H, W, 3) fp32, mean-subtracted RGB, padded into the bucket.
-    im_info: (N, 3) — (real_h, real_w, scale).
+    images: (N, H, W, 3) padded into the bucket — uint8 raw RGB (the
+      TPU-native default; normalized on device, see ops/normalize.py) or
+      fp32 mean-subtracted (host-normalized path).
+    im_info: (N, 3) — (real_h, real_w, scale) of the resized image.
     gt_boxes: (N, G, 4) padded gt boxes in input coordinates.
     gt_classes: (N, G) int32 class ids (1..C-1; 0 is background).
     gt_valid: (N, G) bool.
@@ -198,7 +200,8 @@ def loss_and_metrics(
     variables = {"params": params, "batch_stats": batch_stats}
     k_anchor, k_rcnn = jax.random.split(key)
 
-    feat = model.apply(variables, batch.images, method=model.features)
+    feat = model.apply(variables, batch.images, batch.im_info,
+                       method=model.features)
     rpn_cls, rpn_box = model.apply(variables, feat, method=model.rpn_raw)
     _, fh, fw, _ = feat.shape
     anchors = model.anchors_for(fh, fw)
@@ -244,7 +247,8 @@ def loss_and_metrics_rpn(
     ``train_rpn.py``): backbone → RPN heads → anchor targets → two losses.
     Shares ``_rpn_losses`` with the e2e objective."""
     variables = {"params": params, "batch_stats": batch_stats}
-    feat = model.apply(variables, batch.images, method=model.features)
+    feat = model.apply(variables, batch.images, batch.im_info,
+                       method=model.features)
     rpn_cls, rpn_box = model.apply(variables, feat, method=model.rpn_raw)
     _, fh, fw, _ = feat.shape
     anchors = model.anchors_for(fh, fw)
@@ -266,7 +270,8 @@ def loss_and_metrics_rcnn(
     2/4; ref ``train_rcnn.py`` + host-side ``sample_rois``).  Shares
     ``_rcnn_losses`` with the e2e objective."""
     variables = {"params": params, "batch_stats": batch_stats}
-    feat = model.apply(variables, batch.images, method=model.features)
+    feat = model.apply(variables, batch.images, batch.im_info,
+                       method=model.features)
     cls_loss, bbox_loss, metrics = _rcnn_losses(
         model, variables, feat, batch.rois, batch.rois_valid, batch, key,
         cfg)

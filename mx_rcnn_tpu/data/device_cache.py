@@ -1,0 +1,110 @@
+"""HBM-resident epoch cache: feed training entirely from device memory.
+
+No reference equivalent — the reference streams every batch host→device
+each step (``rcnn/core/loader.py`` + MXNet IO), which is the right call
+when the interconnect is PCIe and the host has cores to spare.  On a
+TPU fed through a high-latency link (a tunneled dev chip, or a weak host
+in general), per-step transfers dominate: every host→device RPC costs a
+round trip, and a 27 ms train step cannot hide a ~40-80 ms transfer
+latency.
+
+The TPU-native answer for RAM-scale datasets (benchmarks, VOC-sized sets,
+synthetic suites): stage ONE epoch of already-assembled batches in HBM
+(uint8 images keep it 4x smaller — 32 batches of 2x608x1024 ≈ 120 MB),
+then let each step GATHER its batch from the resident buffer with an
+index derived on device.  Steady-state host↔device traffic per step: one
+dispatch RPC, zero data bytes.  Measured on the tunneled v5e chip this
+takes sustained training from 9.5 to 69.5 imgs/s — 0.95x the pure device
+rate (see docs/PERF.md).
+
+Semantic deviation from the streaming loader (disclosed): batch
+COMPOSITION is frozen at build time; per-epoch shuffling permutes batch
+ORDER only (on device, via ``jax.random.permutation`` keyed by the epoch
+number).  The streaming loader re-groups images into new batches each
+epoch.  For datasets large enough for grouping to matter, use the
+streaming path — this cache targets sets that fit in HBM anyway.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DeviceEpochCache:
+    """One bucket's epoch of batches, stacked and resident on device.
+
+    ``data`` is the batch pytree with a leading ``num_batches`` axis.
+    Multi-bucket datasets build one cache per bucket
+    (:func:`build_caches`).
+    """
+
+    def __init__(self, batches: List, device=None):
+        if not batches:
+            raise ValueError("empty batch list")
+        shapes = {tuple(b.images.shape) for b in batches}
+        if len(shapes) > 1:
+            raise ValueError(f"mixed bucket shapes in one cache: {shapes}")
+        stacked = jax.tree.map(lambda *xs: np.stack(xs), *batches)
+        self.num_batches = len(batches)
+        self.data = (jax.device_put(stacked, device) if device is not None
+                     else jax.device_put(stacked))
+        self.nbytes = sum(x.nbytes for x in jax.tree.leaves(stacked))
+
+    def index_handle(self) -> jnp.ndarray:
+        """A fresh device-resident step counter for :func:`make_cached_step`
+        (int32 scalar; carried through the step so the host never ships an
+        index)."""
+        return jnp.zeros((), jnp.int32)
+
+
+def build_caches(loader, max_bytes: int = 4 << 30) -> List[DeviceEpochCache]:
+    """Materialize one epoch from ``loader`` and upload it, grouped by
+    bucket shape.  Raises if the epoch exceeds ``max_bytes`` (caller falls
+    back to the streaming loader)."""
+    by_shape = {}
+    total = 0
+    for b in loader:
+        by_shape.setdefault(tuple(b.images.shape), []).append(b)
+        total += sum(x.nbytes for x in jax.tree.leaves(b))
+        if total > max_bytes:
+            raise MemoryError(
+                f"epoch exceeds device cache budget ({total} > {max_bytes} "
+                f"bytes); use the streaming loader")
+    return [DeviceEpochCache(bs) for bs in by_shape.values()]
+
+
+def make_cached_step(base_step: Callable, num_batches: int,
+                     shuffle: bool = True) -> Callable:
+    """Wrap a ``(state, batch, key) -> (state, metrics)`` train step into a
+    ``(state, data, idx, key) -> (state, idx', metrics)`` step that gathers
+    its batch from a resident :class:`DeviceEpochCache` epoch.
+
+    ``idx`` is the cache's device-resident step counter
+    (:meth:`DeviceEpochCache.index_handle`); the batch used at position
+    ``p = idx % num_batches`` of epoch ``e = idx // num_batches`` is
+    ``perm_e[p]`` with ``perm_e`` a per-epoch device permutation (or the
+    identity when ``shuffle`` is False).  Jit with
+    ``donate_argnums=(0, 2)`` — state and counter update in place; the
+    epoch data is a non-donated resident buffer.
+    """
+
+    def step(state, data, idx, key):
+        pos = jnp.mod(idx, num_batches)
+        if shuffle:
+            epoch = idx // num_batches
+            perm = jax.random.permutation(
+                jax.random.fold_in(key, epoch), num_batches)
+            i = perm[pos]
+        else:
+            i = pos
+        batch = jax.tree.map(
+            lambda x: jax.lax.dynamic_index_in_dim(x, i, keepdims=False),
+            data)
+        new_state, metrics = base_step(state, batch, key)
+        return new_state, idx + 1, metrics
+
+    return step

@@ -84,20 +84,22 @@ def choose_bucket(h: int, w: int, buckets: Sequence[Tuple[int, int]]
     return max(same or buckets, key=lambda b: b[0] * b[1])
 
 
-def load_and_transform(
+def load_resized_uint8(
     path: str,
     flipped: bool,
-    pixel_means: Sequence[float],
     scale: int,
     max_size: int,
     bucket: Tuple[int, int],
 ) -> Tuple[np.ndarray, float]:
-    """Full per-image host pipeline: read → flip → resize → mean-subtract →
-    pad into the bucket.  Returns ((bh, bw, 3) fp32 image, im_scale)."""
+    """Decode → flip → resize (→ shrink-to-fit the bucket), staying uint8.
+
+    Returns an UNPADDED contiguous (h, w, 3) uint8 RGB image with
+    ``h <= bucket[0]`` and ``w <= bucket[1]``, plus ``im_scale``.  This is
+    the cacheable half of the host pipeline: everything downstream (pad,
+    normalize) is either a memcpy or runs on device
+    (``ops/normalize.py``)."""
     # stay uint8 through decode/flip/resize (cv2 resizes uint8 ~3x faster
-    # than fp32 and the arrays are 4x smaller); the fp32 cast fuses with the
-    # mean subtraction into the padded output buffer — on a host with few
-    # cores the loader competes with nothing else for exactly this time
+    # than fp32 and the arrays are 4x smaller)
     img = imread_rgb(path)
     if flipped:
         img = img[:, ::-1, :]
@@ -113,8 +115,26 @@ def load_and_transform(
             img = np.asarray(Image.fromarray(np.ascontiguousarray(img)
                                              ).resize((new_w, new_h)))
         im_scale *= fit
-        h, w = new_h, new_w
+    return np.ascontiguousarray(img), im_scale
+
+
+def load_and_transform(
+    path: str,
+    flipped: bool,
+    pixel_means: Sequence[float],
+    scale: int,
+    max_size: int,
+    bucket: Tuple[int, int],
+) -> Tuple[np.ndarray, float]:
+    """Full per-image host pipeline: read → flip → resize → mean-subtract →
+    pad into the bucket.  Returns ((bh, bw, 3) fp32 image, im_scale)."""
+    img, im_scale = load_resized_uint8(path, flipped, scale, max_size, bucket)
+    h, w = img.shape[:2]
+    bh, bw = bucket
     out = np.zeros((bh, bw, 3), dtype=np.float32)
+    # the fp32 cast fuses with the mean subtraction into the padded output
+    # buffer (device-side normalization via ops/normalize.py computes the
+    # identical float32 values)
     np.subtract(img, np.asarray(pixel_means, dtype=np.float32),
                 out=out[:h, :w], casting="unsafe")
     return out, im_scale

@@ -26,9 +26,63 @@ import numpy as np
 
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.core.train import Batch
+from mx_rcnn_tpu.data.cache import DecodedImageCache, plan_scale
 from mx_rcnn_tpu.data.image import (choose_bucket, compute_scale,
-                                    load_and_transform)
+                                    load_resized_uint8)
 from mx_rcnn_tpu.data.roidb import Roidb
+
+
+def cache_from_config(cfg: Config) -> DecodedImageCache | None:
+    """Build the decoded-image cache the config asks for (None = disabled)."""
+    d = cfg.default
+    if d.image_cache_mb <= 0 and not d.image_cache_dir:
+        return None
+    return DecodedImageCache(ram_bytes=d.image_cache_mb << 20,
+                             cache_dir=d.image_cache_dir or None)
+
+
+class _ImageSource:
+    """Shared decode/cache plumbing for the three loaders.
+
+    ``raw_images=True`` (the TPU-native default) emits uint8 batches that
+    the model normalizes on device (``ops/normalize.py``); False reproduces
+    the reference's host-side fp32 mean-subtract.  Either mode can sit on a
+    :class:`DecodedImageCache`.  im_info records the ACTUAL post-resize dims
+    (matching the reference, which reads them off the resized array) — the
+    device-side normalization mask depends on them being exact.
+    """
+
+    def _init_source(self, cfg: Config, raw_images, cache) -> None:
+        self.raw_images = (cfg.default.raw_images if raw_images is None
+                           else raw_images)
+        self.cache = cache
+        self._pixel_means = np.asarray(cfg.network.pixel_means, np.float32)
+
+    def _image_into(self, out: np.ndarray, rec, bucket) -> Tuple[int, int, float]:
+        """Decode ``rec`` (through the cache if present) and write it into
+        ``out`` (one padded bucket slot).  Returns (h, w, im_scale)."""
+        cfg = self.cfg
+        scale, max_size = cfg.bucket.scale, cfg.bucket.max_size
+        flipped = rec.get("flipped", False)
+        if self.cache is not None:
+            img = self.cache.load(rec["image"], flipped, scale, max_size,
+                                  bucket)
+            im_scale = plan_scale(rec["height"], rec["width"], scale,
+                                  max_size, bucket)
+        else:
+            img, im_scale = load_resized_uint8(rec["image"], flipped, scale,
+                                               max_size, bucket)
+        h, w = img.shape[:2]
+        if self.raw_images:
+            out[:h, :w] = img
+        else:
+            np.subtract(img, self._pixel_means, out=out[:h, :w],
+                        casting="unsafe")
+        return h, w, im_scale
+
+    def _image_buffer(self, n: int, bucket) -> np.ndarray:
+        dtype = np.uint8 if self.raw_images else np.float32
+        return np.zeros((n, bucket[0], bucket[1], 3), dtype)
 
 
 def _prefetched(work: Iterable, make: Callable, num_workers: int,
@@ -76,7 +130,7 @@ def _bucket_of(rec, buckets, scale, max_size) -> Tuple[int, int]:
     return choose_bucket(int(round(h * s)), int(round(w * s)), buckets)
 
 
-class AnchorLoader:
+class AnchorLoader(_ImageSource):
     """Training loader (name kept for reference parity).
 
     Iterating yields ``Batch`` namedtuples of static shape; all images in a
@@ -86,9 +140,11 @@ class AnchorLoader:
 
     def __init__(self, roidb: Roidb, cfg: Config, batch_images: int = None,
                  shuffle: bool = True, seed: int = 0,
-                 num_workers: int = None, prefetch: int = None):
+                 num_workers: int = None, prefetch: int = None,
+                 raw_images: bool = None, cache: DecodedImageCache = None):
         self.roidb = list(roidb)
         self.cfg = cfg
+        self._init_source(cfg, raw_images, cache)
         self.batch_images = batch_images or cfg.train.batch_images
         self.shuffle = shuffle
         self.seed = seed
@@ -118,21 +174,15 @@ class AnchorLoader:
         cfg = self.cfg
         g = cfg.train.max_gt_boxes
         n = len(indices)
-        bh, bw = bucket
-        images = np.zeros((n, bh, bw, 3), np.float32)
+        images = self._image_buffer(n, bucket)
         im_info = np.zeros((n, 3), np.float32)
         gt_boxes = np.zeros((n, g, 4), np.float32)
         gt_classes = np.zeros((n, g), np.int32)
         gt_valid = np.zeros((n, g), bool)
         for j, i in enumerate(indices):
             rec = self.roidb[i]
-            img, im_scale = load_and_transform(
-                rec["image"], rec.get("flipped", False),
-                cfg.network.pixel_means, cfg.bucket.scale,
-                cfg.bucket.max_size, bucket)
-            images[j] = img
-            im_info[j] = (round(rec["height"] * im_scale),
-                          round(rec["width"] * im_scale), im_scale)
+            h, w, im_scale = self._image_into(images[j], rec, bucket)
+            im_info[j] = (h, w, im_scale)
             k = min(len(rec["boxes"]), g)
             if k:
                 gt_boxes[j, :k] = rec["boxes"][:k] * im_scale
@@ -199,9 +249,11 @@ class ROIIter(AnchorLoader):
     def __init__(self, roidb: Roidb, cfg: Config, proposals: Sequence,
                  batch_images: int = None, shuffle: bool = True,
                  seed: int = 0, max_rois: int = None,
-                 num_workers: int = None, prefetch: int = None):
+                 num_workers: int = None, prefetch: int = None,
+                 raw_images: bool = None, cache: DecodedImageCache = None):
         super().__init__(roidb, cfg, batch_images, shuffle, seed,
-                         num_workers=num_workers, prefetch=prefetch)
+                         num_workers=num_workers, prefetch=prefetch,
+                         raw_images=raw_images, cache=cache)
         if len(proposals) != len(self.roidb):
             raise ValueError(
                 f"{len(proposals)} proposal sets for {len(self.roidb)} "
@@ -226,16 +278,18 @@ class ROIIter(AnchorLoader):
         return RCNNBatch(*base, rois=rois, rois_valid=rois_valid)
 
 
-class TestLoader:
+class TestLoader(_ImageSource):
     """Evaluation loader (ref ``TestLoader``): yields
     ``(Batch, indices, scales)`` — gt fields are zero-filled, ``indices``
     are roidb positions and ``scales`` un-map detections back to raw image
     coordinates (ref pred_eval divides boxes by im_scale)."""
 
     def __init__(self, roidb: Roidb, cfg: Config, batch_images: int = None,
-                 num_workers: int = None, prefetch: int = None):
+                 num_workers: int = None, prefetch: int = None,
+                 raw_images: bool = None, cache: DecodedImageCache = None):
         self.roidb = list(roidb)
         self.cfg = cfg
+        self._init_source(cfg, raw_images, cache)
         self.batch_images = batch_images or cfg.test.batch_images
         self.num_workers = (cfg.default.num_workers if num_workers is None
                             else num_workers)
@@ -261,22 +315,16 @@ class TestLoader:
     def _make_batch(self, chunk: Sequence[int], bucket):
         cfg = self.cfg
         n = len(chunk)
-        bh, bw = bucket
-        images = np.zeros((n, bh, bw, 3), np.float32)
+        images = self._image_buffer(n, bucket)
         im_info = np.zeros((n, 3), np.float32)
         scales = np.zeros((n,), np.float32)
         for j, i in enumerate(chunk):
             rec = self.roidb[i]
-            # honor the flipped flag: eval roidbs never set it, but
-            # alternate training generates proposals over the
+            # the flipped flag is honored here: eval roidbs never set it,
+            # but alternate training generates proposals over the
             # flip-augmented TRAIN roidb through this loader
-            img, im_scale = load_and_transform(
-                rec["image"], rec.get("flipped", False),
-                cfg.network.pixel_means,
-                cfg.bucket.scale, cfg.bucket.max_size, bucket)
-            images[j] = img
-            im_info[j] = (round(rec["height"] * im_scale),
-                          round(rec["width"] * im_scale), im_scale)
+            h, w, im_scale = self._image_into(images[j], rec, bucket)
+            im_info[j] = (h, w, im_scale)
             scales[j] = im_scale
         g = cfg.train.max_gt_boxes
         batch = Batch(

@@ -28,6 +28,7 @@ from mx_rcnn_tpu.models.resnet import ResNetBackbone, ResNetHead
 from mx_rcnn_tpu.models.rpn import RPNHead
 from mx_rcnn_tpu.models.vgg import VGGBackbone, VGGHead
 from mx_rcnn_tpu.ops.anchors import generate_shifted_anchors
+from mx_rcnn_tpu.ops.normalize import normalize_images
 from mx_rcnn_tpu.ops.proposal import propose
 from mx_rcnn_tpu.ops.roi_pool import roi_align
 
@@ -51,6 +52,9 @@ class FasterRCNN(nn.Module):
     test_post_nms_top_n: int = 300
     test_nms_thresh: float = 0.7
     test_min_size: int = 16
+    # ref PIXEL_MEANS — applied ON DEVICE when the loader ships raw uint8
+    # batches (ops/normalize.py); fp32 host-normalized input passes through
+    pixel_means: Tuple[float, ...] = (123.68, 116.779, 103.939)
     dtype: Dtype = jnp.float32
 
     @property
@@ -83,8 +87,14 @@ class FasterRCNN(nn.Module):
 
     # ---- pieces (used by the train step) ----------------------------------
 
-    def features(self, images: jnp.ndarray) -> jnp.ndarray:
-        """(N, H, W, 3) mean-subtracted RGB → (N, H/16, W/16, C)."""
+    def features(self, images: jnp.ndarray,
+                 im_info: jnp.ndarray = None) -> jnp.ndarray:
+        """(N, H, W, 3) RGB → (N, H/16, W/16, C) backbone features.
+
+        ``images`` is either fp32 mean-subtracted (host-normalized path) or
+        raw uint8 (TPU-native path) — uint8 needs ``im_info`` so the
+        on-device normalization masks padding back to exact zeros."""
+        images = normalize_images(images, im_info, self.pixel_means)
         return self.backbone(images)
 
     def rpn_raw(self, feat: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -113,7 +123,7 @@ class FasterRCNN(nn.Module):
         """RPN-only forward (ref ``get_*_rpn_test`` symbol): images →
         (rois, fg scores, valid) — used by generate_proposals in alternate
         training and by test_rpn."""
-        feat = self.features(images)
+        feat = self.features(images, im_info)
         rpn_cls, rpn_box = self.rpn_raw(feat)
         _, fh, fw, _ = feat.shape
         anchors = self.anchors_for(fh, fw)
@@ -143,7 +153,7 @@ class FasterRCNN(nn.Module):
           rois (N, R, 4), roi_valid (N, R), cls_prob (N, R, classes),
           bbox_deltas (N, R, 4*classes) — R = test_post_nms_top_n.
         """
-        feat = self.features(images)
+        feat = self.features(images, im_info)
         rpn_cls, rpn_box = self.rpn_raw(feat)
         n, fh, fw, _ = feat.shape
         anchors = self.anchors_for(fh, fw)
@@ -190,5 +200,6 @@ def build_model(cfg: Config) -> FasterRCNN:
         test_post_nms_top_n=cfg.test.rpn_post_nms_top_n,
         test_nms_thresh=cfg.test.rpn_nms_thresh,
         test_min_size=cfg.test.rpn_min_size,
+        pixel_means=tuple(cfg.network.pixel_means),
         dtype=jnp.bfloat16 if cfg.network.compute_dtype == "bfloat16" else jnp.float32,
     )

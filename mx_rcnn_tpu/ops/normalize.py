@@ -1,0 +1,58 @@
+"""Device-side image normalization for raw uint8 batches.
+
+The reference host loader subtracts PIXEL_MEANS on the CPU and ships fp32
+tensors (``rcnn/io/image.py — transform``).  On TPU that is backwards: the
+fp32 mean-subtract is a ~10 ms/image host memory sweep and quadruples the
+host→device transfer, while on device the same subtract is a trivially
+fused elementwise prologue to the first convolution.  So the TPU-native
+loader ships uint8 (4x less PCIe/host bandwidth) and this op normalizes
+in-graph.
+
+Bit-exactness contract: ``normalize_images(u8_batch, im_info, means)``
+produces the IDENTICAL float32 tensor the host path
+(``data/image.py — load_and_transform``) would have produced — valid pixels
+are float32(uint8) - float32(mean) (same operand types, same order), and
+padding beyond each image's real (h, w) stays exactly 0.0 (the host path
+zero-fills the bucket before subtracting into the valid region only).
+``tests/test_data.py`` asserts this bitwise.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def normalize_images(images: jnp.ndarray, im_info: jnp.ndarray | None,
+                     pixel_means: Sequence[float]) -> jnp.ndarray:
+    """Normalize a raw uint8 image batch on device; fp32 passes through.
+
+    Args:
+      images: (N, H, W, 3) — either uint8 raw RGB (padded into the bucket
+        with zeros) or float32 already mean-subtracted (host path).
+      im_info: (N, 3) of (real_h, real_w, scale); required for uint8 input
+        — the mask bounds.  The loader records the ACTUAL resized dims here,
+        so the mask covers exactly the valid pixels.
+      pixel_means: RGB means (ref PIXEL_MEANS).
+
+    Returns (N, H, W, 3) float32, mean-subtracted, zero beyond (h_i, w_i).
+    """
+    if images.dtype != jnp.uint8:
+        return images
+    if im_info is None:
+        raise ValueError("uint8 image batches need im_info to bound the "
+                         "valid region during device-side normalization")
+    n, h, w, _ = images.shape
+    means = jnp.asarray(pixel_means, jnp.float32)
+    x = images.astype(jnp.float32) - means
+    # mask padding back to exactly 0.0: uint8 zero-padding minus the mean
+    # would leave -mean at the borders, which the convolution's padding
+    # would then see (the host path pads with true zeros)
+    row = jax.lax.broadcasted_iota(jnp.int32, (1, h, w, 1), 1)
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, h, w, 1), 2)
+    hi = im_info[:, 0].reshape(n, 1, 1, 1)
+    wi = im_info[:, 1].reshape(n, 1, 1, 1)
+    mask = (row < hi) & (col < wi)
+    return jnp.where(mask, x, 0.0)

@@ -28,7 +28,9 @@ import time
 import numpy as np
 
 
-def make_batch(cfg, batch_images, h, w, seed=0):
+def make_batch(cfg, batch_images, h, w, seed=0, raw=False):
+    """Synthetic training batch; ``raw=True`` emits the uint8 image layout
+    the production loader ships (device-side normalization path)."""
     import jax.numpy as jnp
 
     from mx_rcnn_tpu.core.train import Batch
@@ -46,8 +48,13 @@ def make_batch(cfg, batch_images, h, w, seed=0):
         gt_boxes[i, :n_gt, 2:] = np.minimum(xy + wh, [w - 1, h - 1])
         gt_classes[i, :n_gt] = rng.randint(1, cfg.dataset.num_classes, n_gt)
         gt_valid[i, :n_gt] = True
+    if raw:
+        images = jnp.asarray(
+            rng.randint(0, 256, (batch_images, h, w, 3)), jnp.uint8)
+    else:
+        images = jnp.asarray(rng.randn(batch_images, h, w, 3), jnp.float32)
     return Batch(
-        images=jnp.asarray(rng.randn(batch_images, h, w, 3), jnp.float32),
+        images=images,
         im_info=jnp.tile(jnp.array([[float(h), float(w), 1.0]]),
                          (batch_images, 1)),
         gt_boxes=jnp.asarray(gt_boxes),

@@ -45,6 +45,8 @@ def test_rcnn(cfg: Config, *, prefix: str, epoch: int,
                 f"--num_devices {num_devices} but only {available} "
                 f"device(s) available")
         mesh = device_mesh(num_devices)
+    # no decoded-image cache: eval reads each image exactly once, so
+    # caching would only add RSS (the cache pays off on multi-epoch reads)
     loader = TestLoader(roidb, cfg,
                         batch_images=cfg.test.batch_images * num_devices)
     model = build_model(cfg)

@@ -21,7 +21,7 @@ import jax
 from mx_rcnn_tpu.config import Config, generate_config
 from mx_rcnn_tpu.core.fit import fit
 from mx_rcnn_tpu.core.train import setup_training
-from mx_rcnn_tpu.data import AnchorLoader, load_gt_roidb
+from mx_rcnn_tpu.data import AnchorLoader, cache_from_config, load_gt_roidb
 from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.utils.checkpoint import restore_state
 
@@ -58,16 +58,18 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
     logger.info("[%s] training on %d roidb images", mode, len(roidb))
 
     n_total = cfg.train.batch_images * num_devices
+    cache = cache_from_config(cfg)
     if mode == "rcnn":
         from mx_rcnn_tpu.data.loader import ROIIter
 
         if proposals is None:
             raise ValueError("mode='rcnn' requires precomputed proposals")
         loader = ROIIter(roidb, cfg, proposals, batch_images=n_total,
-                         shuffle=cfg.train.shuffle, seed=seed)
+                         shuffle=cfg.train.shuffle, seed=seed, cache=cache)
     else:
         loader = AnchorLoader(roidb, cfg, batch_images=n_total,
-                              shuffle=cfg.train.shuffle, seed=seed)
+                              shuffle=cfg.train.shuffle, seed=seed,
+                              cache=cache)
     steps_per_epoch = max(len(loader), 1)
     logger.info("%d batches/epoch (global batch %d)", steps_per_epoch,
                 n_total)

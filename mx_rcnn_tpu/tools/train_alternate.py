@@ -46,7 +46,7 @@ def _dump_proposals(cfg: Config, roidb, prefix: str, epoch: int,
     roidb (ref ``test_rpn.py — generate_proposals`` writes rpn_data pkl)."""
     model = build_model(cfg)
     params, batch_stats = load_param(prefix, epoch)
-    loader = TestLoader(roidb, cfg)
+    loader = TestLoader(roidb, cfg)  # single pass per stage: no cache
     props = generate_proposals(
         model, {"params": params, "batch_stats": batch_stats}, loader, cfg)
     with open(out_path, "wb") as f:

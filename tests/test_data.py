@@ -210,3 +210,115 @@ def test_skip_next_batches(tmp_path):
     ref.set_epoch(3)
     cut.set_epoch(3)
     assert len(list(cut)) == len(list(ref))
+
+
+def _mini_roidb(tmp_path, n=4):
+    ds = SyntheticDataset("train", str(tmp_path), "", num_images=n,
+                          image_size=(120, 160))
+    return ds.gt_roidb()
+
+
+def test_raw_loader_bitexact_vs_host_normalized(tmp_path):
+    """The uint8 raw path + device normalization must reproduce the host
+    fp32 mean-subtract path BITWISE (ops/normalize.py contract)."""
+    import jax.numpy as jnp
+
+    from mx_rcnn_tpu.ops.normalize import normalize_images
+
+    cfg = generate_config("tiny", "synthetic")
+    roidb = _mini_roidb(tmp_path)
+    host = AnchorLoader(roidb, cfg, batch_images=2, shuffle=False,
+                        num_workers=0, raw_images=False)
+    raw = AnchorLoader(roidb, cfg, batch_images=2, shuffle=False,
+                       num_workers=0, raw_images=True)
+    for bh, br in zip(host, raw):
+        assert br.images.dtype == np.uint8
+        assert bh.images.dtype == np.float32
+        np.testing.assert_array_equal(bh.im_info, br.im_info)
+        normed = np.asarray(normalize_images(
+            jnp.asarray(br.images), jnp.asarray(br.im_info),
+            cfg.network.pixel_means))
+        np.testing.assert_array_equal(normed, bh.images)
+
+
+def test_normalize_passthrough_and_uint8_guard():
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from mx_rcnn_tpu.ops.normalize import normalize_images
+
+    x = jnp.ones((1, 4, 4, 3), jnp.float32)
+    assert normalize_images(x, None, (1.0, 2.0, 3.0)) is x
+    with _pytest.raises(ValueError):
+        normalize_images(x.astype(jnp.uint8), None, (1.0, 2.0, 3.0))
+
+
+def test_decoded_image_cache_ram_and_disk(tmp_path):
+    from mx_rcnn_tpu.data.cache import DecodedImageCache, plan_scale
+    from mx_rcnn_tpu.data.image import load_resized_uint8
+
+    cfg = generate_config("tiny", "synthetic")
+    roidb = _mini_roidb(tmp_path)
+    bucket = cfg.bucket.shapes[0]
+    sc, ms = cfg.bucket.scale, cfg.bucket.max_size
+
+    cache = DecodedImageCache(ram_bytes=1 << 30,
+                              cache_dir=str(tmp_path / "imgcache"))
+    rec = roidb[0]
+    direct, direct_scale = load_resized_uint8(rec["image"], False, sc, ms,
+                                              bucket)
+    got = cache.load(rec["image"], False, sc, ms, bucket)
+    np.testing.assert_array_equal(got, direct)
+    assert cache.misses == 1 and cache.hits == 0
+    # RAM hit
+    got2 = cache.load(rec["image"], False, sc, ms, bucket)
+    np.testing.assert_array_equal(got2, direct)
+    assert cache.hits == 1
+    # disk tier: a fresh cache instance over the same dir must hit disk
+    cache2 = DecodedImageCache(ram_bytes=0,
+                               cache_dir=str(tmp_path / "imgcache"))
+    got3 = cache2.load(rec["image"], False, sc, ms, bucket)
+    np.testing.assert_array_equal(got3, direct)
+    assert cache2.hits == 1 and cache2.misses == 0
+    # plan_scale matches the decode path's scale exactly
+    assert plan_scale(rec["height"], rec["width"], sc, ms, bucket) \
+        == direct_scale
+    # flipped variant gets its own key
+    flipped = cache.load(rec["image"], True, sc, ms, bucket)
+    assert (flipped != got).any()
+
+
+def test_cached_loader_identical_batches(tmp_path):
+    """A cache-backed loader must yield batches identical to the direct
+    loader, epoch after epoch (including flip keys)."""
+    from mx_rcnn_tpu.data.cache import DecodedImageCache
+    from mx_rcnn_tpu.data.roidb import IMDB
+
+    cfg = generate_config("tiny", "synthetic")
+    roidb = IMDB.append_flipped_images(_mini_roidb(tmp_path))
+    cache = DecodedImageCache(ram_bytes=1 << 30)
+    plain = AnchorLoader(roidb, cfg, batch_images=2, shuffle=True, seed=7,
+                         num_workers=0)
+    cached = AnchorLoader(roidb, cfg, batch_images=2, shuffle=True, seed=7,
+                          num_workers=0, cache=cache)
+    for _ in range(2):  # second epoch runs fully from cache
+        for bp, bc in zip(plain, cached):
+            np.testing.assert_array_equal(bp.images, bc.images)
+            np.testing.assert_array_equal(bp.im_info, bc.im_info)
+            np.testing.assert_array_equal(bp.gt_boxes, bc.gt_boxes)
+    assert cache.hits > 0
+
+
+def test_ram_cache_eviction_budget():
+    from mx_rcnn_tpu.data.cache import DecodedImageCache
+
+    c = DecodedImageCache(ram_bytes=100)
+    a = np.zeros((5, 8, 3), np.uint8)  # 120 bytes > budget: never stored
+    c._ram_put("a", a)
+    assert c._ram_used == 0
+    b = np.zeros((4, 4, 3), np.uint8)  # 48 bytes
+    c._ram_put("b", b)
+    c._ram_put("c", b.copy())
+    assert c._ram_used == 96
+    c._ram_put("d", b.copy())  # evicts the LRU entry ("b")
+    assert c._ram_used == 96 and "b" not in c._ram and "d" in c._ram
