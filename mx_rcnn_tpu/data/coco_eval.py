@@ -1,12 +1,16 @@
-"""COCO-style bbox mAP evaluation, dependency-free.
+"""COCO-style mAP evaluation (bbox AND segm modes), dependency-free.
 
-Reference: the vendored ``rcnn/pycocotools/cocoeval.py — COCOeval`` (bbox
-mode).  pycocotools is not installable in this environment, so the bbox
-evaluation protocol is reimplemented here in NumPy: greedy score-ordered
-matching per (category, IoU threshold), crowd boxes as ignore regions,
-101-point interpolated precision averaged over IoU 0.50:0.95:0.05, plus the
-AP50/AP75 and small/medium/large area breakdowns.  RLE mask evaluation is
-NOT reimplemented (the reference only uses bbox eval for Faster R-CNN).
+Reference: the vendored ``rcnn/pycocotools/cocoeval.py — COCOeval``.
+pycocotools is not installable in this environment, so the evaluation
+protocol is reimplemented here in NumPy: greedy score-ordered matching per
+(category, IoU threshold), crowd annotations as ignore regions, 101-point
+interpolated precision averaged over IoU 0.50:0.95:0.05, plus the AP50/AP75
+and small/medium/large area breakdowns.  The matcher is vectorized over the
+10 thresholds and fuzz-checked against a direct transcription of the
+published algorithm (``tests/test_coco_eval.py``).  Segm mode
+(:func:`evaluate_segm`) computes IoUs and areas from RLE masks via the
+native maskApi port (``mx_rcnn_tpu/native``) and shares the matcher and
+accumulation with bbox mode exactly, mirroring pycocotools' iouType switch.
 """
 
 from __future__ import annotations
@@ -43,49 +47,90 @@ def _iou_xyxy(dets: np.ndarray, gts: np.ndarray, iscrowd: np.ndarray
     return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
 
 
+def _last_argmax(a: np.ndarray) -> np.ndarray:
+    """Row-wise argmax returning the LAST index among ties — the greedy
+    matcher's update rule (`iou < best → continue; best ← iou` updates on
+    equality, so a later gt with equal IoU wins)."""
+    n = a.shape[1]
+    return n - 1 - np.argmax(a[:, ::-1], axis=1)
+
+
 def _evaluate_image(dets: np.ndarray, gt_boxes: np.ndarray,
                     gt_ignore: np.ndarray, iscrowd: np.ndarray,
-                    max_dets: int):
+                    max_dets: int, ious: np.ndarray = None):
     """Match one image's detections for all IoU thresholds at once.
 
+    Semantics are the pycocotools greedy matcher
+    (``cocoeval.py — evaluateImg``), vectorized over the 10 IoU thresholds
+    and the gt axis; only the (data-dependent) loop over detections remains,
+    and it skips detections whose best IoU can't reach the lowest threshold.
+    The reference loop's rules, preserved exactly (fuzz-checked against a
+    direct transcription in ``tests/test_coco_eval.py``):
+      * gts sorted real-first / ignored-last; a det prefers ANY real match
+        over a higher-IoU ignored match (the transcription's break),
+      * equal-IoU ties go to the later gt index,
+      * used non-crowd gts leave the candidate pool; crowd gts can absorb
+        any number of detections.
+
+    ``ious``: optional precomputed (D_sorted, G_unsorted) matrix (crowd
+    semantics applied) — lets the caller share it across area ranges.
     Returns (det_scores (D,), det_matched (T, D), det_ignore (T, D),
     num_gt_not_ignored).
     """
     order = np.argsort(-dets[:, 4], kind="mergesort")[:max_dets]
     dets = dets[order]
-    nd = len(dets)
-    ngt = len(gt_boxes)
+    if len(gt_boxes) and len(dets) and ious is None:
+        ious = _iou_xyxy(dets[:, :4], gt_boxes, iscrowd)
+    elif ious is not None:
+        ious = ious[:max_dets]
+    matched, ignored = _match_image(ious, len(gt_boxes), gt_ignore, iscrowd,
+                                    len(dets))
+    return dets[:, 4], matched, ignored, int((~gt_ignore).sum())
+
+
+def _match_image(ious, ngt: int, gt_ignore: np.ndarray, iscrowd: np.ndarray,
+                 nd: int):
+    """The matcher core for one image: ``ious`` is the (D_sorted, G) matrix
+    over score-sorted capped detections and UNSORTED gts (None when either
+    side is empty).  Returns (matched (T, D), ignored (T, D))."""
     t = len(IOU_THRS)
     matched = np.zeros((t, nd), bool)
     ignored = np.zeros((t, nd), bool)
-    if ngt:
+    if ngt and nd:
         # sort gt: real first, ignored last (pycocotools order)
         gt_order = np.argsort(gt_ignore, kind="mergesort")
-        gt_boxes = gt_boxes[gt_order]
         gt_ignore_s = gt_ignore[gt_order]
         crowd_s = iscrowd[gt_order]
-        ious = _iou_xyxy(dets[:, :4], gt_boxes, crowd_s)
-        for ti, thr in enumerate(IOU_THRS):
-            gt_used = np.zeros(ngt, bool)
-            for di in range(nd):
-                best_iou = min(thr, 1 - 1e-10)
-                best_g = -1
-                for gi in range(ngt):
-                    if gt_used[gi] and not crowd_s[gi]:
-                        continue
-                    # stop matching real gt once we reach ignored ones if a
-                    # real match was already found
-                    if best_g > -1 and not gt_ignore_s[best_g] and gt_ignore_s[gi]:
-                        break
-                    if ious[di, gi] < best_iou:
-                        continue
-                    best_iou = ious[di, gi]
-                    best_g = gi
-                if best_g >= 0:
-                    gt_used[best_g] = True
-                    matched[ti, di] = True
-                    ignored[ti, di] = gt_ignore_s[best_g]
-    return dets[:, 4], matched, ignored, int((~gt_ignore).sum())
+        ious = ious[:, gt_order]
+        n_real = int((~gt_ignore_s).sum())
+        thr_e = np.minimum(IOU_THRS, 1 - 1e-10)  # (T,)
+        gt_used = np.zeros((t, ngt), bool)
+        # a det whose best IoU is below the lowest threshold can never
+        # match — skip it (matched/ignored stay False)
+        for di in np.nonzero(ious.max(axis=1) >= thr_e[0])[0]:
+            avail = ~gt_used | crowd_s[None, :]           # (T, G)
+            vals = np.where(avail, ious[di][None, :], -1.0)
+            if n_real:
+                best_rv = vals[:, :n_real].max(axis=1)
+                best_ri = _last_argmax(vals[:, :n_real])
+            else:
+                best_rv = np.full(t, -1.0)
+                best_ri = np.zeros(t, np.intp)
+            if ngt > n_real:
+                best_iv = vals[:, n_real:].max(axis=1)
+                best_ii = n_real + _last_argmax(vals[:, n_real:])
+            else:
+                best_iv = np.full(t, -1.0)
+                best_ii = np.zeros(t, np.intp)
+            has_r = best_rv >= thr_e
+            has_i = ~has_r & (best_iv >= thr_e)
+            chosen = np.where(has_r, best_ri,
+                              np.where(has_i, best_ii, -1))
+            sel = chosen >= 0
+            gt_used[np.nonzero(sel)[0], chosen[sel]] = True
+            matched[:, di] = sel
+            ignored[:, di] = has_i
+    return matched, ignored
 
 
 def evaluate_bbox(
@@ -103,55 +148,131 @@ def evaluate_bbox(
       categories: category ids to evaluate.
     Returns dict with AP, AP50, AP75, AP_small/medium/large, AR_100.
     """
-    images = list(gt_by_image_cat.keys())
+    def fetch(img, cat):
+        gt = gt_by_image_cat[img].get(cat)
+        if gt is None:
+            gt_boxes = np.zeros((0, 4))
+            iscrowd = np.zeros((0,), bool)
+            areas = np.zeros((0,))
+        else:
+            gt_boxes = np.asarray(gt["boxes"]).reshape(-1, 4)
+            iscrowd = np.asarray(
+                gt.get("iscrowd", np.zeros(len(gt_boxes), bool)), bool)
+            areas = np.asarray(gt.get(
+                "area",
+                (gt_boxes[:, 2] - gt_boxes[:, 0])
+                * (gt_boxes[:, 3] - gt_boxes[:, 1])))
+        dets = dets_by_image_cat.get(img, {}).get(cat)
+        dets = (np.asarray(dets).reshape(-1, 5) if dets is not None
+                else np.zeros((0, 5)))
+        if len(dets) == 0 and len(gt_boxes) == 0:
+            return None
+        order = np.argsort(-dets[:, 4], kind="mergesort")[:max_dets]
+        dets_s = dets[order]
+        ious = (_iou_xyxy(dets_s[:, :4], gt_boxes, iscrowd)
+                if len(gt_boxes) and len(dets_s) else None)
+        d_area = (dets_s[:, 2] - dets_s[:, 0]) \
+            * (dets_s[:, 3] - dets_s[:, 1])
+        return dets_s[:, 4], d_area, ious, gt_boxes.shape[0], areas, iscrowd
+
+    return _run_eval(list(gt_by_image_cat.keys()), categories, fetch)
+
+
+def evaluate_segm(
+    dets_by_image_cat: Mapping[str, Mapping[int, Sequence]],
+    gt_by_image_cat: Mapping[str, Mapping[int, Dict]],
+    categories: Sequence[int],
+    max_dets: int = 100,
+) -> Dict[str, float]:
+    """COCO segmentation (mask) AP — the segm-mode counterpart of
+    :func:`evaluate_bbox`, sharing its matcher and accumulation exactly
+    (ref vendored ``pycocotools/cocoeval.py`` with iouType='segm', mask IoU
+    from ``maskApi``).
+
+    Args:
+      dets_by_image_cat: image id → {category → list of (rle, score)
+        pairs}, ``rle`` in the ``native`` RLE dict format
+        (``mx_rcnn_tpu.native.encode``/``from_poly``).
+      gt_by_image_cat: image id → {category → dict(rles (n,),
+        iscrowd (n,) bool, area (n,) optional — defaults to mask area)}.
+      categories: category ids to evaluate.
+    Returns the same metric dict as :func:`evaluate_bbox`.
+    """
+    from mx_rcnn_tpu import native
+
+    def fetch(img, cat):
+        gt = gt_by_image_cat[img].get(cat)
+        if gt is None:
+            gt_rles, iscrowd, areas = [], np.zeros(0, bool), np.zeros(0)
+        else:
+            gt_rles = list(gt["rles"])
+            iscrowd = np.asarray(
+                gt.get("iscrowd", np.zeros(len(gt_rles), bool)), bool)
+            areas = np.asarray(
+                gt["area"] if "area" in gt
+                else [native.area(r) for r in gt_rles], float)
+        dets = dets_by_image_cat.get(img, {}).get(cat) or []
+        if not dets and not gt_rles:
+            return None
+        scores = np.asarray([s for _, s in dets], float)
+        order = np.argsort(-scores, kind="mergesort")[:max_dets]
+        d_rles = [dets[i][0] for i in order]
+        d_scores = scores[order]
+        d_area = np.asarray([native.area(r) for r in d_rles], float)
+        ious = None
+        if d_rles and gt_rles:
+            ious = np.array([[native.iou(d, g, bool(c))
+                              for g, c in zip(gt_rles, iscrowd)]
+                             for d in d_rles])
+        return d_scores, d_area, ious, len(gt_rles), areas, iscrowd
+
+    return _run_eval(list(gt_by_image_cat.keys()), categories, fetch)
+
+
+def _run_eval(images, categories, fetch) -> Dict[str, float]:
+    """Shared eval driver: per (image, cat) ``fetch`` returns
+    (det_scores SORTED desc + capped, det_areas, ious (D, G)|None, n_gt,
+    gt_areas, iscrowd) or None when the image has neither dets nor gts.
+    Matching per area range + the 101-point accumulation are identical for
+    bbox and segm modes (pycocotools ``evaluate``/``accumulate``)."""
     t = len(IOU_THRS)
     precisions = {k: [] for k in AREA_RANGES}  # per (cat): (T, 101) arrays
     recalls = {k: [] for k in AREA_RANGES}
 
     for cat in categories:
-        per_area_stats = {k: [] for k in AREA_RANGES}
-        for area_name, (lo, hi) in AREA_RANGES.items():
-            scores_all, matched_all, ignored_all = [], [], []
-            npos = 0
-            for img in images:
-                gt = gt_by_image_cat[img].get(cat)
-                if gt is None:
-                    gt_boxes = np.zeros((0, 4))
-                    iscrowd = np.zeros((0,), bool)
-                    areas = np.zeros((0,))
-                else:
-                    gt_boxes = np.asarray(gt["boxes"]).reshape(-1, 4)
-                    iscrowd = np.asarray(
-                        gt.get("iscrowd", np.zeros(len(gt_boxes), bool)), bool)
-                    areas = np.asarray(gt.get(
-                        "area",
-                        (gt_boxes[:, 2] - gt_boxes[:, 0])
-                        * (gt_boxes[:, 3] - gt_boxes[:, 1])))
+        # one pass over images: the IoU matrix is computed ONCE per
+        # (image, cat) — gt sorting and matching differ per area range,
+        # the IoUs do not (crowd semantics are area-independent)
+        acc = {k: dict(scores=[], matched=[], ignored=[], npos=0)
+               for k in AREA_RANGES}
+        for img in images:
+            got = fetch(img, cat)
+            if got is None:
+                continue
+            scores, d_area, ious, n_gt, areas, iscrowd = got
+            for area_name, (lo, hi) in AREA_RANGES.items():
                 gt_ignore = iscrowd | (areas < lo) | (areas >= hi)
-                dets = dets_by_image_cat.get(img, {}).get(cat)
-                dets = (np.asarray(dets).reshape(-1, 5) if dets is not None
-                        else np.zeros((0, 5)))
-                if len(dets) == 0 and len(gt_boxes) == 0:
-                    continue
-                s, m, ig, np_img = _evaluate_image(
-                    dets, gt_boxes, gt_ignore, iscrowd, max_dets)
+                m, ig = _match_image(ious, n_gt, gt_ignore, iscrowd,
+                                     len(scores))
                 # detections outside the area range that match nothing are
                 # ignored too (pycocotools marks unmatched out-of-range dets)
-                d_area = (dets[:, 2] - dets[:, 0]) * (dets[:, 3] - dets[:, 1])
-                order = np.argsort(-dets[:, 4], kind="mergesort")[:max_dets]
-                oor = (d_area[order] < lo) | (d_area[order] >= hi)
+                oor = (d_area < lo) | (d_area >= hi)
                 ig = ig | (~m & oor[None, :])
-                scores_all.append(s)
-                matched_all.append(m)
-                ignored_all.append(ig)
-                npos += np_img
+                a = acc[area_name]
+                a["scores"].append(scores)
+                a["matched"].append(m)
+                a["ignored"].append(ig)
+                a["npos"] += int((~gt_ignore).sum())
+        for area_name in AREA_RANGES:
+            a = acc[area_name]
+            npos = a["npos"]
             if npos == 0:
-                per_area_stats[area_name] = None
                 continue
-            scores = np.concatenate(scores_all) if scores_all else np.zeros(0)
-            matched = (np.concatenate(matched_all, axis=1) if matched_all
+            scores = (np.concatenate(a["scores"]) if a["scores"]
+                      else np.zeros(0))
+            matched = (np.concatenate(a["matched"], axis=1) if a["matched"]
                        else np.zeros((t, 0), bool))
-            ignored = (np.concatenate(ignored_all, axis=1) if ignored_all
+            ignored = (np.concatenate(a["ignored"], axis=1) if a["ignored"]
                        else np.zeros((t, 0), bool))
             order = np.argsort(-scores, kind="mergesort")
             matched = matched[:, order]
@@ -164,19 +285,15 @@ def evaluate_bbox(
                 fps = np.cumsum(~matched[ti][keep])
                 rec = tps / npos
                 prec = tps / np.maximum(tps + fps, 1e-12)
-                # make precision monotonically decreasing then sample
-                for i in range(len(prec) - 1, 0, -1):
-                    prec[i - 1] = max(prec[i - 1], prec[i])
+                # precision envelope: monotonically non-increasing, sampled
+                # at the 101 recall points (pycocotools accumulate)
+                prec = np.maximum.accumulate(prec[::-1])[::-1]
                 idx = np.searchsorted(rec, RECALL_THRS, side="left")
                 valid = idx < len(prec)
                 prec_interp[ti, valid] = prec[idx[valid]]
                 rec_final[ti] = rec[-1] if len(rec) else 0.0
-            per_area_stats[area_name] = (prec_interp, rec_final)
-        for area_name in AREA_RANGES:
-            st = per_area_stats[area_name]
-            if st is not None:
-                precisions[area_name].append(st[0])
-                recalls[area_name].append(st[1])
+            precisions[area_name].append(prec_interp)
+            recalls[area_name].append(rec_final)
 
     def mean_ap(area: str, thr_idx=None) -> float:
         ps = precisions[area]
