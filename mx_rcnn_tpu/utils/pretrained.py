@@ -151,15 +151,23 @@ def _conv_kernel(w: np.ndarray) -> np.ndarray:
 
 
 def map_mxnet_resnet(raw: Dict[str, np.ndarray]
-                     ) -> Tuple[Dict, Dict]:
-    """MXNet resnet-v2 zoo names → (params updates, batch_stats updates).
+                     ) -> Tuple[Dict, Dict, list]:
+    """MXNet resnet-v2 zoo names → (params updates, batch_stats updates,
+    leftover names).
 
     ``stage4_*`` and the closing ``bn1`` belong to the per-ROI head module
     (ref runs conv5 per ROI — ``symbol_resnet.py`` get_resnet_train).
+
+    ``leftover`` lists raw arrays that mapped NOWHERE — the ImageNet
+    classifier (``fc1_*``/``softmax*``) is expected and not reported;
+    anything else there means the file doesn't follow the zoo naming and
+    the caller must refuse it (silent drops would train from a partly
+    random backbone).
     """
     raw = _strip(raw)
     params: Dict = {"backbone": {}, "head": {}}
     stats: Dict = {"backbone": {}, "head": {}}
+    leftover: list = []
 
     def put(tree, module, scope, leaf, value):
         node = tree.setdefault(module, {})
@@ -199,7 +207,9 @@ def map_mxnet_resnet(raw: Dict[str, np.ndarray]
                 put(params if dest == "params" else stats, module, scope,
                     leaf, value)
                 break
-    return params, stats
+        else:
+            leftover.append(name)
+    return params, stats, leftover
 
 
 # torchvision vgg16 'features.N' indices → reference conv names
@@ -219,13 +229,17 @@ def _fc_kernel_chw_to_hwc(w: np.ndarray, c: int, h: int, w_: int
             .reshape(h * w_ * c, out))
 
 
-def map_vgg16(raw: Dict[str, np.ndarray], pooled=(7, 7)) -> Tuple[Dict, Dict]:
-    """VGG16 weights → (params updates, {}).  Accepts torchvision
-    (``features.N.weight``/``classifier.N.weight``) or MXNet zoo
-    (``conv1_1_weight``/``fc6_weight``) naming.  fc6 kernels are permuted
-    from the source's CHW flatten to this repo's NHWC flatten."""
+def map_vgg16(raw: Dict[str, np.ndarray], pooled=(7, 7)
+              ) -> Tuple[Dict, Dict, list]:
+    """VGG16 weights → (params updates, {}, leftover names).  Accepts
+    torchvision (``features.N.weight``/``classifier.N.weight``) or MXNet
+    zoo (``conv1_1_weight``/``fc6_weight``) naming.  fc6 kernels are
+    permuted from the source's CHW flatten to this repo's NHWC flatten.
+    ``leftover``: arrays that mapped nowhere (the ImageNet fc8 /
+    ``classifier.6`` is expected and not reported)."""
     raw = _strip(raw)
     params: Dict = {"backbone": {}, "head": {}}
+    leftover: list = []
     ph, pw = pooled
     for name, arr in raw.items():
         if name.startswith("features."):
@@ -233,6 +247,7 @@ def map_vgg16(raw: Dict[str, np.ndarray], pooled=(7, 7)) -> Tuple[Dict, Dict]:
             leaf = name.split(".")[2]
             conv_name = _TV_VGG16.get(idx)
             if conv_name is None:
+                leftover.append(name)
                 continue
             val = _conv_kernel(arr) if leaf == "weight" else arr
             params["backbone"].setdefault(conv_name, {})[
@@ -243,7 +258,9 @@ def map_vgg16(raw: Dict[str, np.ndarray], pooled=(7, 7)) -> Tuple[Dict, Dict]:
             leaf = name.split(".")[2]
             fc = {0: "fc6", 3: "fc7"}.get(idx)
             if fc is None:
-                continue  # classifier.6 = ImageNet fc8
+                if idx != 6:  # classifier.6 = ImageNet fc8, expected
+                    leftover.append(name)
+                continue
             val = arr
             if leaf == "weight":
                 val = (_fc_kernel_chw_to_hwc(arr, 512, ph, pw) if fc == "fc6"
@@ -267,7 +284,9 @@ def map_vgg16(raw: Dict[str, np.ndarray], pooled=(7, 7)) -> Tuple[Dict, Dict]:
             params["head"].setdefault(fc, {})[
                 "kernel" if leaf == "weight" else "bias"] = np.asarray(
                     val, np.float32)
-    return params, {}
+        elif not name.startswith("fc8_"):  # fc8 = ImageNet classifier
+            leftover.append(name)
+    return params, {}, leftover
 
 
 def _graft(tree: Dict, updates: Dict, path: str = "") -> int:
@@ -310,11 +329,16 @@ def load_pretrained_into(state, path: str, epoch: int, cfg):
     raw = load_raw(path)
     name = cfg.network.name
     if name.startswith("resnet"):
-        p_up, s_up = map_mxnet_resnet(raw)
+        p_up, s_up, leftover = map_mxnet_resnet(raw)
     elif name == "vgg":
-        p_up, s_up = map_vgg16(raw, cfg.network.rcnn_pooled_size)
+        p_up, s_up, leftover = map_vgg16(raw, cfg.network.rcnn_pooled_size)
     else:
         raise ValueError(f"no pretrained mapping for network {name!r}")
+    if leftover:
+        raise ValueError(
+            f"{path}: {len(leftover)} arrays map to nothing in the model "
+            f"(e.g. {sorted(leftover)[:5]}) — the file does not follow a "
+            f"supported zoo naming; refusing to silently drop weights")
 
     params = jax.tree.map(lambda x: x, state.params)  # copy
     stats = jax.tree.map(lambda x: x, state.batch_stats)

@@ -185,8 +185,8 @@ def test_vgg16_torchvision_mapping_functional(tmp_path):
         rng.randn(4096, 4096).astype(np.float32))
     sd["classifier.3.bias"] = torch.tensor(rng.randn(4096).astype(np.float32))
 
-    p_up, s_up = map_vgg16({k: v.numpy() for k, v in sd.items()})
-    assert not s_up
+    p_up, s_up, leftover = map_vgg16({k: v.numpy() for k, v in sd.items()})
+    assert not s_up and not leftover
     assert set(p_up["backbone"]) == set(_TV_VGG16.values())
     assert set(p_up["head"]) == {"fc6", "fc7"}
 
@@ -208,3 +208,189 @@ def test_vgg16_torchvision_mapping_functional(tmp_path):
     w_t = sd["features.0.weight"].numpy()[0]  # (3, 3, 3) OIHW → I H W
     theirs_px = float((patch.transpose(2, 0, 1) * w_t).sum())
     np.testing.assert_allclose(ours_px, theirs_px, rtol=1e-4, atol=1e-4)
+
+
+# ---- VERDICT r02 item 4: independent zoo name set + activation check -------
+
+def _zoo_resnet_v2_names(units, filters=(256, 512, 1024, 2048)):
+    """The FULL name/shape set of an MXNet ResNet-v2 zoo checkpoint
+    (tornadomeet/ResNet layout, the files the reference trains from, e.g.
+    ``resnet-101-0000.params``), generated from the PUBLISHED naming
+    convention — deliberately independent of this repo's model tree, so
+    coverage is checked against reality instead of circularly.
+
+    Returns {mxnet_name: shape}; `arg:`/`aux:` prefixes included.
+    """
+    shapes = {}
+
+    def bn(scope, c):
+        shapes[f"arg:{scope}_gamma"] = (c,)
+        shapes[f"arg:{scope}_beta"] = (c,)
+        shapes[f"aux:{scope}_moving_mean"] = (c,)
+        shapes[f"aux:{scope}_moving_var"] = (c,)
+
+    bn("bn_data", 3)
+    shapes["arg:conv0_weight"] = (64, 3, 7, 7)
+    bn("bn0", 64)
+    in_ch = 64
+    for si, (n_unit, f) in enumerate(zip(units, filters), start=1):
+        m = f // 4
+        for u in range(1, n_unit + 1):
+            s = f"stage{si}_unit{u}"
+            bn(f"{s}_bn1", in_ch)
+            shapes[f"arg:{s}_conv1_weight"] = (m, in_ch, 1, 1)
+            bn(f"{s}_bn2", m)
+            shapes[f"arg:{s}_conv2_weight"] = (m, m, 3, 3)
+            bn(f"{s}_bn3", m)
+            shapes[f"arg:{s}_conv3_weight"] = (f, m, 1, 1)
+            if u == 1:
+                shapes[f"arg:{s}_sc_weight"] = (f, in_ch, 1, 1)
+            in_ch = f
+    bn("bn1", filters[-1])
+    shapes["arg:fc1_weight"] = (1000, filters[-1])
+    shapes["arg:fc1_bias"] = (1000,)
+    return shapes
+
+
+@pytest.mark.slow
+def test_resnet101_zoo_nameset_zero_unmatched_both_directions(tmp_path):
+    """A synthesized FULL resnet-101 zoo checkpoint must load with zero
+    unmatched keys in BOTH directions: every non-classifier zoo array is
+    consumed (leftover == []) and every model backbone/head leaf is
+    covered (enforced inside load_pretrained_into)."""
+    shapes = _zoo_resnet_v2_names(units=(3, 4, 23, 3))
+    rng = np.random.RandomState(0)
+    named = {}
+    for k, shp in shapes.items():
+        a = rng.randn(*shp).astype(np.float32)
+        if k.endswith("_moving_var"):
+            a = np.abs(a) + 0.5
+        named[k] = a
+    path = str(tmp_path / "resnet-101-0000.params")
+    write_mxnet_params(path, named)
+
+    cfg = generate_config("resnet101", "PascalVOC")
+    cfg = cfg.replace_in("network", compute_dtype="float32")
+    cfg = cfg.replace_in("train", rpn_pre_nms_top_n=64, rpn_post_nms_top_n=16,
+                         batch_rois=8, max_gt_boxes=4)
+    model = build_model(cfg)
+    state, tx = setup_training(model, cfg, KEY, (1, 64, 64, 3),
+                               steps_per_epoch=10)
+    new_state = load_pretrained_into(state, str(tmp_path / "resnet-101"), 0,
+                                     cfg)
+    # direction 1: zero leftover — map consumed every non-classifier array
+    _, _, leftover = map_mxnet_resnet(named)
+    assert leftover == []
+    # direction 2: every backbone/head leaf was replaced
+    for module in ("backbone", "head"):
+        changed = jax.tree.map(
+            lambda a, b: not np.array_equal(np.asarray(a), np.asarray(b)),
+            state.params[module], new_state.params[module])
+        assert all(jax.tree.leaves(changed)), module
+    # count parity: zoo arrays (minus fc1) == model leaves touched
+    n_zoo = len([k for k in named if not k.startswith("arg:fc1")])
+    n_model = (len(jax.tree.leaves(new_state.params["backbone"]))
+               + len(jax.tree.leaves(new_state.params["head"]))
+               + len(jax.tree.leaves(new_state.batch_stats["backbone"]))
+               + len(jax.tree.leaves(new_state.batch_stats["head"])))
+    assert n_zoo == n_model
+    # an extra array with no recognizable suffix → leftover, refused
+    bad = dict(named)
+    bad["arg:mystery_blob"] = np.zeros((3, 3), np.float32)
+    write_mxnet_params(str(tmp_path / "bad-0000.params"), bad)
+    with pytest.raises(ValueError, match="map to nothing"):
+        load_pretrained_into(state, str(tmp_path / "bad"), 0, cfg)
+    # an extra array with a known suffix but unknown scope → graft refuses
+    bad2 = dict(named)
+    bad2["arg:mystery_weight"] = np.zeros((3, 3, 1, 1), np.float32)
+    write_mxnet_params(str(tmp_path / "bad2-0000.params"), bad2)
+    with pytest.raises(KeyError, match="mystery"):
+        load_pretrained_into(state, str(tmp_path / "bad2"), 0, cfg)
+
+
+def _np_conv2d_same(x, k_oihw, stride=1):
+    """Plain-NumPy NHWC conv with SAME padding from an OIHW kernel —
+    independent of jax/flax layout conventions."""
+    kh, kw = k_oihw.shape[2], k_oihw.shape[3]
+    h, w, _ = x.shape
+    oh = (h + stride - 1) // stride
+    ow = (w + stride - 1) // stride
+    pad_h = max((oh - 1) * stride + kh - h, 0)
+    pad_w = max((ow - 1) * stride + kw - w, 0)
+    xp = np.pad(x, ((pad_h // 2, pad_h - pad_h // 2),
+                    (pad_w // 2, pad_w - pad_w // 2), (0, 0)))
+    out = np.zeros((oh, ow, k_oihw.shape[0]), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[i * stride:i * stride + kh,
+                       j * stride:j * stride + kw, :]  # (kh, kw, C)
+            # OIHW → sum over H, W, I
+            out[i, j] = np.einsum("hwc,ochw->o", patch, k_oihw)
+    return out
+
+
+def _np_bn(x, gamma, beta, mean, var, eps=2e-5):
+    return gamma * (x - mean) / np.sqrt(var + eps) + beta
+
+
+def test_bottleneck_activation_matches_numpy_reference(tmp_path):
+    """Activation-level pin of the OIHW→HWIO + BN mapping: a full
+    pre-activation bottleneck unit loaded from MXNet-named weights must
+    reproduce a plain-NumPy forward of the same OIHW weights (the v2
+    residual_unit: bn→relu→1x1 → bn→relu→3x3 → bn→relu→1x1 + proj
+    shortcut from the first activation)."""
+    from mx_rcnn_tpu.models.resnet import BottleneckUnit
+    from mx_rcnn_tpu.utils.pretrained import _graft
+
+    rng = np.random.RandomState(7)
+    in_ch, f = 8, 16
+    m = f // 4
+    named = {}
+    for scope, c in (("stage1_unit1_bn1", in_ch), ("stage1_unit1_bn2", m),
+                     ("stage1_unit1_bn3", m)):
+        named[f"arg:{scope}_gamma"] = rng.randn(c).astype(np.float32)
+        named[f"arg:{scope}_beta"] = rng.randn(c).astype(np.float32)
+        named[f"aux:{scope}_moving_mean"] = rng.randn(c).astype(np.float32)
+        named[f"aux:{scope}_moving_var"] = (
+            np.abs(rng.randn(c)) + 0.5).astype(np.float32)
+    named["arg:stage1_unit1_conv1_weight"] = rng.randn(
+        m, in_ch, 1, 1).astype(np.float32)
+    named["arg:stage1_unit1_conv2_weight"] = rng.randn(
+        m, m, 3, 3).astype(np.float32)
+    named["arg:stage1_unit1_conv3_weight"] = rng.randn(
+        f, m, 1, 1).astype(np.float32)
+    named["arg:stage1_unit1_sc_weight"] = rng.randn(
+        f, in_ch, 1, 1).astype(np.float32)
+
+    p_up, s_up, leftover = map_mxnet_resnet(named)
+    assert leftover == []
+    unit = BottleneckUnit(filters=f, stride=1, dim_match=False,
+                          dtype=jnp.float32)
+    x = rng.randn(1, 6, 6, in_ch).astype(np.float32)
+    variables = unit.init(KEY, jnp.asarray(x))
+    params = jax.tree.map(np.asarray, variables["params"])
+    stats = jax.tree.map(np.asarray, variables["batch_stats"])
+    _graft(params, p_up["backbone"]["stage1_unit1"])
+    _graft(stats, s_up["backbone"]["stage1_unit1"])
+    got = np.asarray(unit.apply(
+        {"params": params, "batch_stats": stats}, jnp.asarray(x)))[0]
+
+    # independent NumPy forward from the ORIGINAL OIHW arrays
+    def g(n):
+        return named[f"arg:stage1_unit1_{n}"]
+
+    def st(n):
+        return (named[f"arg:stage1_unit1_{n}_gamma"],
+                named[f"arg:stage1_unit1_{n}_beta"],
+                named[f"aux:stage1_unit1_{n}_moving_mean"],
+                named[f"aux:stage1_unit1_{n}_moving_var"])
+
+    a1 = np.maximum(_np_bn(x[0], *st("bn1")), 0)
+    c1 = _np_conv2d_same(a1, g("conv1_weight"))
+    a2 = np.maximum(_np_bn(c1, *st("bn2")), 0)
+    c2 = _np_conv2d_same(a2, g("conv2_weight"))
+    a3 = np.maximum(_np_bn(c2, *st("bn3")), 0)
+    c3 = _np_conv2d_same(a3, g("conv3_weight"))
+    sc = _np_conv2d_same(a1, g("sc_weight"))
+    want = c3 + sc
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
