@@ -46,3 +46,10 @@ def shrink_tiny_cfg(cfg):
     cfg = cfg.replace_in("bucket", scale=128, max_size=160,
                          shapes=((128, 160), (160, 128)))
     return cfg
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: training-loop / subprocess / e2e tests excluded from the "
+        "quick tier (run with `make test-all` or `-m slow`)")

@@ -13,3 +13,9 @@ from mx_rcnn_tpu.tools.multihost_demo import launch
 
 def test_two_process_training_losses_agree():
     assert launch(2, steps=3) == 0
+
+
+def test_four_process_hierarchical_losses_agree():
+    """Four processes x 2 CPU devices: the (dcn=4, ici=2) hierarchical mesh
+    synchronizes gradients across all 8 devices (VERDICT r02 item 8)."""
+    assert launch(4, steps=2) == 0
