@@ -13,7 +13,7 @@ NATIVE_DIR := mx_rcnn_tpu/native
 NATIVE_LIB := $(NATIVE_DIR)/libmxrcnn_native.so
 NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 
-.PHONY: all native test clean
+.PHONY: all native test test-all clean
 
 all: native
 
@@ -22,7 +22,12 @@ native: $(NATIVE_LIB)
 $(NATIVE_LIB): $(NATIVE_SRC)
 	$(CXX) $(CXXFLAGS) -o $@ $(NATIVE_SRC)
 
+# quick tier: unit + fast integration, finishes in a few minutes on one core
 test:
+	python -m pytest tests/ -x -q -m "not slow"
+
+# everything, incl. training loops, multi-process rigs, 16-device dryrun
+test-all:
 	python -m pytest tests/ -x -q
 
 clean:

@@ -8,12 +8,16 @@ the final combine valid (ref ``train_alternate.py`` stages 3/4 freeze
 shared convs so RPN and RCNN agree on features).
 """
 
+
+
 import os
 import pickle
 
 import jax
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow
 
 from mx_rcnn_tpu.config import generate_config
 from mx_rcnn_tpu.core.train import RCNNBatch

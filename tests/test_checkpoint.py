@@ -27,6 +27,7 @@ from mx_rcnn_tpu.utils.checkpoint import (
 )
 
 
+@pytest.mark.slow
 def test_save_restore_bit_exact_resume(tmp_path):
     cfg, model, tx, state = tiny_setup()
     step = jax.jit(make_train_step(model, cfg, tx))
@@ -98,6 +99,7 @@ def test_checkpoint_file_is_atomic(tmp_path):
     assert not os.path.exists(checkpoint_path(prefix, 1) + ".tmp")
 
 
+@pytest.mark.slow
 def test_orbax_export_import_roundtrip(tmp_path):
     """Native checkpoint → orbax directory → TrainState, bit-exact
     (ecosystem interop; SURVEY §5.4 names orbax as the TPU standard)."""

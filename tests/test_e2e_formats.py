@@ -9,11 +9,15 @@ way a user with real data hits them (ref ``train_end2end.py`` /
 ``test.py`` on VOC07/COCO).
 """
 
+
+
 import json
 import os
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow
 
 cv2 = pytest.importorskip("cv2")
 

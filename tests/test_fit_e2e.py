@@ -15,10 +15,14 @@ The miniature here trains a few epochs on a small canvas and asserts the
 loop produces real detections and a non-trivial mAP (loose bar: CI noise).
 """
 
+
+
 import os
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow
 
 from mx_rcnn_tpu.config import generate_config
 from mx_rcnn_tpu.tools.test import test_rcnn as eval_rcnn

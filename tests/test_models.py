@@ -14,6 +14,7 @@ from mx_rcnn_tpu.models.vgg import VGGBackbone
 KEY = jax.random.PRNGKey(0)
 
 
+@pytest.mark.slow
 def test_vgg_backbone_stride16():
     m = VGGBackbone()
     x = jnp.zeros((1, 64, 96, 3))
@@ -24,6 +25,7 @@ def test_vgg_backbone_stride16():
     assert "batch_stats" not in v
 
 
+@pytest.mark.slow
 def test_resnet_backbone_stride16_and_width():
     m = ResNetBackbone(depth=50)
     x = jnp.zeros((1, 64, 64, 3))

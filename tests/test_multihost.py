@@ -8,6 +8,10 @@ synchronized across the process (host) boundary.  Ref: MXNet
 SURVEY.md §5.8).
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 from mx_rcnn_tpu.tools.multihost_demo import launch
 
 

@@ -5,6 +5,7 @@ This is the framework's "multi-node without a cluster" strategy
 """
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -42,6 +43,7 @@ def test_dp_step_runs_and_replicas_agree():
     assert np.isfinite(np.asarray(leaf)).all()
 
 
+@pytest.mark.slow
 def test_dp_grad_sync_matches_single_device_global_batch():
     """DP over 8 shards must equal a single-device step on the global batch
     when sampling randomness is aligned: here we verify the *deterministic*
@@ -69,6 +71,7 @@ def test_dp_grad_sync_matches_single_device_global_batch():
     assert max(jax.tree.leaves(d)) < 0.15
 
 
+@pytest.mark.slow
 def test_dp_grad_sync_exact_vs_manual_average():
     """Aligned-RNG exact equivalence (VERDICT r1 item 10): the DP step must
     produce the SAME parameters as manually computing each shard's gradient
@@ -123,6 +126,7 @@ def test_dp_uneven_rng_decorrelated():
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow
 def test_hierarchical_dcn_mesh_matches_flat_mesh():
     """A 2x4 (dcn, ici) mesh must produce the SAME step as the flat 8-device
     mesh: axis_index over both axes linearizes identically, so per-image

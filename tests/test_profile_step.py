@@ -2,6 +2,10 @@
 compile and execute (CPU, tiny network) — the timings themselves are only
 meaningful on real hardware, so this asserts structure, not numbers."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 from mx_rcnn_tpu.tools.profile_step import main
 
 

@@ -2,6 +2,10 @@
 train_rcnn.py): the 4-stage pipeline driven tool-by-tool through argparse,
 the way the reference's shell scripts chain them."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import os
 import pickle
 

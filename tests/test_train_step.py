@@ -5,6 +5,7 @@ the reference's signal was RPNAcc≈0.9+/RCNNAcc≈0.8+ early in training).
 """
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -150,6 +151,7 @@ def test_frozen_mask_bn_affine_network_wide():
     assert shared["backbone"]["stage3_unit5"]["conv1"]["kernel"] is False
 
 
+@pytest.mark.slow
 def test_overfit_single_batch():
     """~40 SGD steps on one synthetic image must drive the losses down and
     the accuracies up — the smoke signal that gradients flow end-to-end."""
