@@ -135,14 +135,21 @@ def main() -> None:
                 donate_argnums=(0, 2))
             idx = epoch.index_handle()
             # compile + warm; the tunneled remote-compile endpoint is
-            # occasionally flaky — retry before giving up on sustained
+            # occasionally flaky — retry before giving up on sustained.
+            # cstep donates state+idx, so every attempt gets fresh copies
+            # (a failed attempt leaves donated buffers deleted)
             for attempt in range(3):
                 try:
-                    state, idx, metrics = cstep(state, epoch.data, idx, key)
+                    s2, i2, metrics = cstep(
+                        jax.tree.map(jnp.copy, state), epoch.data,
+                        jnp.copy(idx), key)
+                    state, idx = s2, i2
                     fetch(metrics["loss"])
                     break
                 except Exception as e:
-                    if attempt == 2:
+                    transient = ("remote_compile" in str(e)
+                                 or "response body" in str(e))
+                    if attempt == 2 or not transient:
                         raise
                     print(f"cached-step warmup retry ({e})", file=sys.stderr)
                     time.sleep(5.0)

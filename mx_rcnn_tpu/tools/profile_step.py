@@ -116,11 +116,24 @@ def main(argv=None) -> None:
     print(f"{'fetch round-trip (floor)':<34s} {rtt * 1e3:9.2f} ms",
           flush=True)
 
+    def retry_compile(fn, *a, **kw):
+        """The tunneled remote-compile endpoint is intermittently flaky
+        — retry ONLY that failure; real errors surface immediately."""
+        for attempt in range(5):
+            try:
+                return fn(*a, **kw)
+            except Exception as e:
+                transient = ("remote_compile" in str(e)
+                             or "response body" in str(e))
+                if attempt == 4 or not transient:
+                    raise
+                time.sleep(5.0)
+
     def timed_loop(stage, label, note=""):
         """stage: carry (f32 scalar) -> carry.  Runs N reps in one program."""
         looped = jax.jit(lambda c: jax.lax.fori_loop(
             0, N, lambda i, cc: stage(cc), c))
-        fetch(looped(jnp.float32(0)))  # compile + warm
+        retry_compile(lambda: fetch(looped(jnp.float32(0))))  # compile+warm
         t0 = time.perf_counter()
         fetch(looped(jnp.float32(0)))
         per = (time.perf_counter() - t0 - rtt) / N
@@ -140,7 +153,7 @@ def main(argv=None) -> None:
         lambda c: carry_of(feat_of(batch.images + c * eps)),
         "backbone fwd")
 
-    feat = jax.jit(feat_of)(batch.images)
+    feat = retry_compile(jax.jit(feat_of), batch.images)
     _, fh, fw, fc = feat.shape
     anchors = jnp.asarray(model.anchors_for(fh, fw))
 
@@ -154,8 +167,8 @@ def main(argv=None) -> None:
 
     t_feat_bwd = timed_loop(feat_bwd, "backbone fwd+bwd (dummy loss)")
 
-    rpn_cls, rpn_box = jax.jit(
-        lambda v, f: model.apply(v, f, method=model.rpn_raw))(variables, feat)
+    rpn_cls, rpn_box = retry_compile(jax.jit(
+        lambda v, f: model.apply(v, f, method=model.rpn_raw)), variables, feat)
     fg = jax.nn.softmax(rpn_cls.astype(jnp.float32), axis=-1)[..., 1]
     box32 = rpn_box.astype(jnp.float32)
 
@@ -173,8 +186,9 @@ def main(argv=None) -> None:
                         f"pre={tr.rpn_pre_nms_top_n} "
                         f"post={tr.rpn_post_nms_top_n}")
 
-    rois, _, rois_valid = jax.jit(jax.vmap(
-        prop_one, in_axes=(0, 0, None, 0)))(fg, box32, anchors, batch.im_info)
+    rois, _, rois_valid = retry_compile(jax.jit(jax.vmap(
+        prop_one, in_axes=(0, 0, None, 0))), fg, box32, anchors,
+        batch.im_info)
 
     at_one = functools.partial(
         anchor_target, rpn_batch_size=tr.rpn_batch_size,
@@ -209,8 +223,9 @@ def main(argv=None) -> None:
 
     t_pt = timed_loop(pt_stage, "proposal_target")
 
-    pt = jax.jit(jax.vmap(pt_one))(rois, rois_valid, batch.gt_boxes,
-                                   batch.gt_classes, batch.gt_valid, keys)
+    pt = retry_compile(jax.jit(jax.vmap(pt_one)), rois, rois_valid,
+                       batch.gt_boxes, batch.gt_classes, batch.gt_valid,
+                       keys)
 
     def ra_stage(c):
         pooled = jax.vmap(lambda f, r: roi_align(
@@ -221,8 +236,8 @@ def main(argv=None) -> None:
     t_ra = timed_loop(ra_stage, "roi_align",
                       f"rois={pt.rois.shape[0] * pt.rois.shape[1]}")
 
-    pooled = jax.jit(jax.vmap(lambda f, r: roi_align(
-        f, r, model.pooled_size, 1.0 / model.feat_stride)))(feat, pt.rois)
+    pooled = retry_compile(jax.jit(jax.vmap(lambda f, r: roi_align(
+        f, r, model.pooled_size, 1.0 / model.feat_stride))), feat, pt.rois)
     flat = pooled.reshape((-1,) + pooled.shape[2:])
 
     def head_stage(c):
@@ -263,10 +278,10 @@ def main(argv=None) -> None:
 
     t_loss_bwd = timed_loop(loss_bwd_stage, "full loss fwd+bwd (no update)")
 
-    grads = jax.jit(lambda: jax.grad(
+    grads = retry_compile(jax.jit(lambda: jax.grad(
         lambda p: loss_and_metrics(model, p, variables["batch_stats"],
                                    batch, key, cfg)[0]
-    )(variables["params"]))()
+    )(variables["params"])))
 
     def opt_stage(c):
         g = jax.tree_util.tree_map(lambda x: x + c * eps.astype(x.dtype),
@@ -278,9 +293,11 @@ def main(argv=None) -> None:
 
     # --- full step (natural chaining through the state) --------------------
     step = jax.jit(make_train_step(model, cfg, tx), donate_argnums=(0,))
-    s = state
-    for _ in range(2):
-        s, metrics = step(s, batch, key)
+    # the step donates its state: give each retry attempt a FRESH copy, or
+    # a failed first attempt leaves deleted buffers for every retry
+    s = retry_compile(
+        lambda: step(jax.tree.map(jnp.copy, state), batch, key))[0]
+    s, metrics = step(s, batch, key)
     fetch(metrics["loss"])
     t0 = time.perf_counter()
     for _ in range(N):

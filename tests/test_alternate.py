@@ -129,3 +129,32 @@ def test_alternate_four_stages_and_combine(tmp_path):
     for a, b in zip(jax.tree.leaves(p_final["cls_score"]),
                     jax.tree.leaves(p_rcnn2["cls_score"])):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_stage2_init_knob(tmp_path):
+    """stage2_init='rpn1' must seed stage 2 from the rpn1 backbone;
+    the default 'fresh' must not (docs/ROUND3.md item-5 ablation)."""
+    cfg = _cfg(tmp_path)
+    prefix = str(tmp_path / "model" / "alt2")
+    alternate_train(cfg, prefix=prefix, rpn_epoch=1, rcnn_epoch=1,
+                    rpn_lr=3e-3, rcnn_lr=0.0, frequent=1000, seed=0,
+                    dataset_kw=KW, stage2_init="rpn1")
+    # rpn trains (lr>0) so rpn1 != the seed-0 init; rcnn lr 0 keeps stage-2
+    # weights at their init → rcnn1 backbone == the TRAINED rpn1 backbone
+    p_rpn1, _ = load_param(f"{prefix}-rpn1", 1)
+    p_rcnn1, _ = load_param(f"{prefix}-rcnn1", 1)
+    for a, b in zip(jax.tree.leaves(p_rpn1["backbone"]),
+                    jax.tree.leaves(p_rcnn1["backbone"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    prefix = str(tmp_path / "model" / "alt3")
+    alternate_train(cfg, prefix=prefix, rpn_epoch=1, rcnn_epoch=1,
+                    rpn_lr=3e-3, rcnn_lr=0.0, frequent=1000, seed=0,
+                    dataset_kw=KW)  # default: fresh
+    p_rpn1, _ = load_param(f"{prefix}-rpn1", 1)
+    p_rcnn1, _ = load_param(f"{prefix}-rcnn1", 1)
+    same = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(p_rpn1["backbone"]),
+                        jax.tree.leaves(p_rcnn1["backbone"])))
+    assert not same
