@@ -91,6 +91,7 @@ def fit(
     epoch_end_callback: Optional[Callable[[int, TrainState], None]] = None,
     profile_dir: Optional[str] = None,
     stop_flag: Optional[Callable[[], bool]] = None,
+    device_cache: bool = False,
 ) -> TrainState:
     """Run ``begin_epoch .. num_epochs`` epochs; checkpoint per epoch.
 
@@ -107,6 +108,14 @@ def fit(
     ``stop_flag``: polled after every step; when it returns True the loop
     saves a mid-epoch interrupt checkpoint (``<prefix>-interrupt.ckpt``)
     and returns — the preemption path (SIGTERM on preemptible TPUs).
+    ``device_cache``: stage the loader's epoch in HBM once and gather each
+    step's batch on device (``data/device_cache.py``) — for RAM/HBM-scale
+    datasets on hosts or links too slow to stream per step.  Semantics
+    deviation (disclosed): batch COMPOSITION is frozen at staging; epochs
+    reshuffle batch ORDER on device (deterministically from ``key`` and
+    the epoch number, so resume stays step-exact; with ``shuffle=False``
+    loaders the run is bit-identical to streaming).  v1 limits: requires a
+    single-bucket dataset and ``mesh=None``.
     Mid-epoch RESUME is driven by ``state.step`` alone: if the incoming
     state is ``skip`` steps past ``begin_epoch``'s start, the first epoch
     skips its first ``skip`` batches; the deterministic per-epoch shuffle
@@ -114,7 +123,37 @@ def fit(
     bit-identical to an uninterrupted one.
     """
     frequent = cfg.default.frequent if frequent is None else frequent
-    if mesh is not None and mesh.size > 1:
+    cache = None
+    if device_cache:
+        if mesh is not None and mesh.size > 1:
+            raise ValueError("device_cache does not compose with a mesh yet")
+        import jax.numpy as jnp
+
+        from mx_rcnn_tpu.data.device_cache import (build_caches,
+                                                   make_cached_step)
+
+        caches = build_caches(train_loader)
+        if len(caches) != 1:
+            raise ValueError(
+                f"device_cache needs a single-bucket dataset "
+                f"(got {len(caches)} buckets); use the streaming loader")
+        cache = caches[0]
+        logger.info("device cache: %d batches staged in HBM (%.0f MB)",
+                    cache.num_batches, cache.nbytes / 1e6)
+        cstep = jax.jit(
+            make_cached_step(make_train_step(model, cfg, tx, mode=mode),
+                             cache.num_batches,
+                             shuffle=getattr(train_loader, "shuffle", True)),
+            donate_argnums=(0, 2))
+        # the gather index IS the global step: restores (incl. mid-epoch
+        # interrupts) resume the exact batch sequence with no bookkeeping
+        idx_box = [jnp.asarray(jax.device_get(state.step), jnp.int32)]
+
+        def run_step(state, batch: Batch):
+            state, idx_box[0], metrics = cstep(state, cache.data,
+                                               idx_box[0], key)
+            return state, metrics
+    elif mesh is not None and mesh.size > 1:
         from mx_rcnn_tpu.parallel.dp import (
             make_dp_train_step, replicate, shard_batch)
 
@@ -153,13 +192,18 @@ def fit(
         nbatch = skip
         tracing = False
         stop_requested = False
-        loader_skips = hasattr(train_loader, "skip_next_batches")
-        if skip and loader_skips:
-            train_loader.skip_next_batches(skip)  # free: trims the order list
-        batch_iter = iter(train_loader)
-        if skip and not loader_skips:
-            for _ in range(skip):  # fallback: decode-and-discard
-                next(batch_iter, None)
+        if cache is not None:
+            # batches gather on device from the staged epoch; the resumed
+            # idx (== state.step) already accounts for the skipped prefix
+            batch_iter = iter([None] * (steps_per_epoch - skip))
+        else:
+            loader_skips = hasattr(train_loader, "skip_next_batches")
+            if skip and loader_skips:
+                train_loader.skip_next_batches(skip)  # trims the order list
+            batch_iter = iter(train_loader)
+            if skip and not loader_skips:
+                for _ in range(skip):  # fallback: decode-and-discard
+                    next(batch_iter, None)
         for batch in batch_iter:
             # trace steps [skip+2, skip+5) of the first epoch: the first two
             # executed steps carry compile

@@ -96,8 +96,12 @@ def make_cached_step(base_step: Callable, num_batches: int,
         pos = jnp.mod(idx, num_batches)
         if shuffle:
             epoch = idx // num_batches
-            perm = jax.random.permutation(
-                jax.random.fold_in(key, epoch), num_batches)
+            # tag the permutation stream so it never collides with the
+            # train step's fold_in(key, state.step) stream (epoch e and
+            # step s=e would otherwise share a key)
+            perm_key = jax.random.fold_in(
+                jax.random.fold_in(key, 0x5A5A5A5), epoch)
+            perm = jax.random.permutation(perm_key, num_batches)
             i = perm[pos]
         else:
             i = pos

@@ -371,7 +371,9 @@ def from_poly(xy: Sequence[float], h: int, w: int) -> Dict:
     """Flat polygon [x0,y0,x1,y1,...] → RLE via even-odd pixel-center fill.
 
     NOTE: the reference maskApi rasterizes a 5x-upsampled boundary, which
-    includes boundary pixels slightly more aggressively; differences are
+    includes boundary pixels slightly more aggressively (measured: a <=1-px
+    boundary band, worst-case IoU 0.93 vs an independent rasterizer on
+    25-55 px star polygons — tests/test_coco_eval.py); differences are
     confined to the 1-px boundary ring.
     """
     xy = np.ascontiguousarray(xy, np.float64).reshape(-1)

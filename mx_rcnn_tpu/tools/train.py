@@ -35,7 +35,8 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
               roidb=None, dataset_kw: dict = None,
               frozen_prefixes=None, mode: str = "e2e", proposals=None,
               init_from=None, profile_dir: str = None, dcn_size: int = 1,
-              resume: bool = False, stop_flag=None):
+              resume: bool = False, stop_flag=None,
+              device_cache: bool = False):
     """Train; returns the final TrainState.
 
     ``mode``: 'e2e' | 'rpn' | 'rcnn' — the alternate-training stage drivers
@@ -144,7 +145,7 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
     state = fit(model, cfg, state, tx, loader, end_epoch, key,
                 begin_epoch=begin_epoch, prefix=prefix, frequent=frequent,
                 mesh=mesh, mode=mode, profile_dir=profile_dir,
-                stop_flag=stop_flag)
+                stop_flag=stop_flag, device_cache=device_cache)
     return state
 
 
@@ -208,6 +209,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--profile_dir", default=None,
                    help="capture a jax.profiler trace of early steps here")
+    p.add_argument("--device_cache", action="store_true",
+                   help="stage the epoch in HBM and gather batches on "
+                        "device (single-bucket datasets; for hosts/links "
+                        "too slow to stream per step — see "
+                        "data/device_cache.py)")
     return p.parse_args(argv)
 
 
@@ -238,7 +244,8 @@ def main(argv=None):
               seed=args.seed, pretrained=args.pretrained,
               pretrained_epoch=args.pretrained_epoch,
               profile_dir=args.profile_dir, dcn_size=args.dcn_size,
-              resume=args.resume, stop_flag=lambda: stop["flag"])
+              resume=args.resume, stop_flag=lambda: stop["flag"],
+              device_cache=args.device_cache)
 
 
 if __name__ == "__main__":

@@ -3,6 +3,7 @@ streaming path and on-device shuffle coverage."""
 
 import numpy as np
 import jax
+import pytest
 import jax.numpy as jnp
 
 from mx_rcnn_tpu.config import generate_config
@@ -95,3 +96,70 @@ def test_build_caches_groups_by_bucket_and_budget(tmp_path):
 
     with pytest.raises(MemoryError):
         build_caches(loader, max_bytes=10)
+
+
+@pytest.mark.slow
+def test_fit_with_device_cache_matches_streaming(tmp_path):
+    """fit(device_cache=True) with a shuffle=False loader must produce the
+    SAME final weights as the streaming fit (bitwise) — the integration
+    contract of the HBM epoch cache with the training driver."""
+    from mx_rcnn_tpu.core.fit import fit
+    from mx_rcnn_tpu.data.loader import AnchorLoader
+    from mx_rcnn_tpu.data.synthetic import SyntheticDataset
+
+    cfg = generate_config("tiny", "synthetic")
+    cfg = cfg.replace_in("train", batch_images=2, rpn_pre_nms_top_n=64,
+                         rpn_post_nms_top_n=16, batch_rois=8, max_gt_boxes=8,
+                         rpn_batch_size=16, rpn_min_size=2)
+    ds = SyntheticDataset("train", str(tmp_path), "", num_images=8,
+                          image_size=(120, 160))
+    roidb = ds.gt_roidb()
+    model = build_model(cfg)
+    key = jax.random.PRNGKey(0)
+    bh, bw = cfg.bucket.shapes[0]
+
+    def train(device_cache):
+        loader = AnchorLoader(roidb, cfg, batch_images=2, shuffle=False,
+                              num_workers=0)
+        state, tx = setup_training(model, cfg, key, (2, bh, bw, 3),
+                                   steps_per_epoch=len(loader))
+        return fit(model, cfg, state, tx, loader, 2, key,
+                   device_cache=device_cache)
+
+    s_stream = train(False)
+    s_cached = train(True)
+    assert int(s_stream.step) == int(s_cached.step) == 8
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        s_stream.params, s_cached.params)
+
+
+def test_fit_device_cache_rejects_mesh_and_multibucket(tmp_path):
+    from mx_rcnn_tpu.core.fit import fit
+    from mx_rcnn_tpu.data.loader import AnchorLoader
+    from mx_rcnn_tpu.data.synthetic import SyntheticDataset
+    from mx_rcnn_tpu.parallel.dp import device_mesh
+
+    cfg = generate_config("tiny", "synthetic")
+    cfg = cfg.replace_in("train", batch_images=1)
+    ds = SyntheticDataset("train", str(tmp_path), "", num_images=4,
+                          image_size=(120, 160))
+    roidb = ds.gt_roidb()
+    # mixed orientations → two buckets
+    roidb[1]["height"], roidb[1]["width"] = roidb[1]["width"], \
+        roidb[1]["height"]
+    model = build_model(cfg)
+    key = jax.random.PRNGKey(0)
+    loader = AnchorLoader(roidb, cfg, batch_images=1, shuffle=False,
+                          num_workers=0)
+    bh, bw = cfg.bucket.shapes[0]
+    state, tx = setup_training(model, cfg, key, (1, bh, bw, 3),
+                               steps_per_epoch=4)
+    import pytest
+
+    with pytest.raises(ValueError, match="mesh"):
+        fit(model, cfg, state, tx, loader, 1, key,
+            mesh=device_mesh(8), device_cache=True)
+    with pytest.raises(ValueError, match="bucket"):
+        fit(model, cfg, state, tx, loader, 1, key, device_cache=True)
