@@ -82,6 +82,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="pickle raw detections here for tools/reeval.py")
     p.add_argument("--num_devices", type=int, default=1,
                    help="shard eval batches over this many devices")
+    p.add_argument("--set", action="append", metavar="SEC__FIELD=VAL",
+                   help="override any config field, e.g. "
+                        "--set train__rpn_pre_nms_top_n=6000 (repeatable)")
     return p.parse_args(argv)
 
 

@@ -33,6 +33,8 @@ def main(argv=None):
     p.add_argument("--epoch", type=int, required=True)
     p.add_argument("--out", required=True, help="output proposal pkl path")
     p.add_argument("--no_flip", action="store_true")
+    p.add_argument("--set", action="append", metavar="SEC__FIELD=VAL",
+                   help="override any config field (repeatable)")
     args = p.parse_args(argv)
     cfg = stage_config(args)
     # proposals are generated over the TRAIN roidb (flip-augmented unless

@@ -168,6 +168,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="stage-2 init when --pretrained is absent (fresh "
                         "mirrors the ref's generic-weights semantics; "
                         "measured equivalent to rpn1 across seeds)")
+    p.add_argument("--set", action="append", metavar="SEC__FIELD=VAL",
+                   help="override any config field, e.g. "
+                        "--set train__rpn_pre_nms_top_n=6000 (repeatable)")
     return p.parse_args(argv)
 
 

@@ -27,6 +27,9 @@ def _stage_args(p: argparse.ArgumentParser, default_prefix: str) -> None:
     p.add_argument("--root_path", default=None)
     p.add_argument("--dataset_path", default=None)
     p.add_argument("--prefix", default=default_prefix)
+    p.add_argument("--set", action="append", metavar="SEC__FIELD=VAL",
+                   help="override any config field, e.g. "
+                        "--set train__rpn_pre_nms_top_n=6000 (repeatable)")
     p.add_argument("--pretrained", default=None)
     p.add_argument("--pretrained_epoch", type=int, default=0)
     p.add_argument("--init_from", default=None,

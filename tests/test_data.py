@@ -322,3 +322,22 @@ def test_ram_cache_eviction_budget():
     assert c._ram_used == 96
     c._ram_put("d", b.copy())  # evicts the LRU entry ("b")
     assert c._ram_used == 96 and "b" not in c._ram and "d" in c._ram
+
+
+def test_config_from_args_set_overrides():
+    """--set section__field=value parses literals and rejects bad keys."""
+    import argparse
+
+    from mx_rcnn_tpu.tools.train import config_from_args
+
+    ns = argparse.Namespace(network="tiny", dataset="synthetic",
+                            set=["train__rpn_pre_nms_top_n=6000",
+                                 "bucket__scale=600",
+                                 "default__prefix=model/x"])
+    cfg = config_from_args(ns)
+    assert cfg.train.rpn_pre_nms_top_n == 6000
+    assert cfg.bucket.scale == 600
+    assert cfg.default.prefix == "model/x"  # literal_eval fallback → str
+    with pytest.raises(ValueError, match="section__field"):
+        config_from_args(argparse.Namespace(
+            network="tiny", dataset="synthetic", set=["badkey"]))

@@ -156,8 +156,6 @@ def test_fit_device_cache_rejects_mesh_and_multibucket(tmp_path):
     bh, bw = cfg.bucket.shapes[0]
     state, tx = setup_training(model, cfg, key, (1, bh, bw, 3),
                                steps_per_epoch=4)
-    import pytest
-
     with pytest.raises(ValueError, match="mesh"):
         fit(model, cfg, state, tx, loader, 1, key,
             mesh=device_mesh(8), device_cache=True)
