@@ -308,5 +308,55 @@ def generate_config(network: str = "resnet101", dataset: str = "PascalVOC",
         section, fname = key.split("__", 1)
         by_section.setdefault(section, {})[fname] = val
     for section, kw in by_section.items():
+        node = getattr(cfg, section, None)
+        if node is not None:
+            kw = {f: _coerce_override(getattr(node, f, None), v,
+                                      f"{section}__{f}")
+                  for f, v in kw.items()}
         cfg = cfg.replace_in(section, **kw)
     return cfg
+
+
+_BOOL_STRINGS = {"true": True, "yes": True, "1": True,
+                 "false": False, "no": False, "0": False}
+
+
+def _coerce_override(cur: Any, val: Any, key: str) -> Any:
+    """Coerce a config override to the field's existing type.
+
+    Frozen dataclasses do no type checking, and CLI ``--set`` values may
+    arrive as strings (``--set train__shuffle=false``) — without coercion
+    the string "false" would be stored and read as truthy.  Unknown fields
+    (cur is None because getattr missed) pass through so replace_in can
+    raise its own error.
+    """
+    if cur is None or val is None:
+        return val
+    if isinstance(cur, bool):
+        if isinstance(val, bool):
+            return val
+        if isinstance(val, int) and val in (0, 1):
+            return bool(val)
+        if isinstance(val, str) and val.lower() in _BOOL_STRINGS:
+            return _BOOL_STRINGS[val.lower()]
+        raise TypeError(f"{key} expects a bool, got {val!r}")
+    if isinstance(cur, int):
+        if isinstance(val, bool) or (isinstance(val, float)
+                                     and not val.is_integer()):
+            raise TypeError(f"{key} expects an int, got {val!r}")
+        try:
+            return int(val)
+        except (TypeError, ValueError):
+            raise TypeError(f"{key} expects an int, got {val!r}")
+    if isinstance(cur, float):
+        try:
+            return float(val)
+        except (TypeError, ValueError):
+            raise TypeError(f"{key} expects a float, got {val!r}")
+    if isinstance(cur, tuple):
+        if isinstance(val, (list, tuple)):
+            return tuple(val)
+        raise TypeError(f"{key} expects a tuple/list, got {val!r}")
+    if isinstance(cur, str) and not isinstance(val, str):
+        raise TypeError(f"{key} expects a string, got {val!r}")
+    return val

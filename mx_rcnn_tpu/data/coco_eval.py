@@ -221,9 +221,7 @@ def evaluate_segm(
         d_area = np.asarray([native.area(r) for r in d_rles], float)
         ious = None
         if d_rles and gt_rles:
-            ious = np.array([[native.iou(d, g, bool(c))
-                              for g, c in zip(gt_rles, iscrowd)]
-                             for d in d_rles])
+            ious = native.iou_matrix(d_rles, gt_rles, iscrowd)
         return d_scores, d_area, ious, len(gt_rles), areas, iscrowd
 
     return _run_eval(list(gt_by_image_cat.keys()), categories, fetch)

@@ -86,6 +86,11 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.rle_to_bbox.restype = None
         lib.rle_iou.argtypes = [u32p, i64, u32p, i64, ctypes.c_int]
         lib.rle_iou.restype = ctypes.c_double
+        i64p = ctypes.POINTER(i64)
+        lib.rle_iou_matrix.argtypes = [
+            u32p, i64p, i64p, i64, u32p, i64p, i64p, i64,
+            ctypes.POINTER(ctypes.c_uint8), f64p]
+        lib.rle_iou_matrix.restype = None
         lib.rle_merge.argtypes = [u32p, i64, u32p, i64, ctypes.c_int, u32p]
         lib.rle_merge.restype = i64
         lib.rle_to_string.argtypes = [u32p, i64, ctypes.c_char_p]
@@ -338,6 +343,45 @@ def iou(dt: Dict, gt: Dict, iscrowd: bool = False) -> float:
     inter = np.logical_and(md, mg).sum()
     denom = md.sum() if iscrowd else np.logical_or(md, mg).sum()
     return float(inter / denom) if denom else 0.0
+
+
+def iou_matrix(dts: Sequence[Dict], gts: Sequence[Dict],
+               iscrowd: Sequence[bool] = None) -> np.ndarray:
+    """Full (len(dts), len(gts)) mask-IoU matrix in ONE native call (the
+    batched form of pycocotools ``rleIou``); per-mask areas are computed
+    once instead of once per pair.  Falls back to pairwise :func:`iou`."""
+    nd, ng = len(dts), len(gts)
+    # ascontiguousarray: a non-contiguous uint8 view would hand its BASE
+    # buffer pointer to C and silently read the wrong crowd flags
+    crowd = np.zeros(ng, np.uint8) if iscrowd is None else \
+        np.ascontiguousarray(iscrowd, np.uint8)
+    if len(crowd) != ng:
+        raise ValueError(f"{len(crowd)} crowd flags for {ng} gts")
+    out = np.zeros((nd, ng), np.float64)
+    if nd == 0 or ng == 0:
+        return out
+    lib = _load()
+    if lib is None:
+        for d in range(nd):
+            for g in range(ng):
+                out[d, g] = iou(dts[d], gts[g], bool(crowd[g]))
+        return out
+
+    def pack(rles):
+        counts = [_counts_of(r) for r in rles]
+        lens = np.array([len(c) for c in counts], np.int64)
+        offs = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int64)
+        return np.concatenate(counts).astype(np.uint32), offs, lens
+
+    cd, do, dl = pack(dts)
+    cg, go, gl = pack(gts)
+    lib.rle_iou_matrix(
+        _cptr(cd, ctypes.c_uint32), _cptr(do, ctypes.c_int64),
+        _cptr(dl, ctypes.c_int64), nd,
+        _cptr(cg, ctypes.c_uint32), _cptr(go, ctypes.c_int64),
+        _cptr(gl, ctypes.c_int64), ng,
+        _cptr(crowd, ctypes.c_uint8), _cptr(out, ctypes.c_double))
+    return out
 
 
 def merge(rles: Sequence[Dict], intersect: bool = False) -> Dict:

@@ -145,6 +145,31 @@ double rle_iou(const uint32_t* dt, int64_t mdt, const uint32_t* gt,
   return denom > 0 ? (double)inter / denom : 0.0;
 }
 
+// Full (nd x ng) IoU matrix over concatenated RLE count buffers (the
+// batched form pycocotools' rleIou exposes): dts/gts hold all counts
+// back-to-back, *_off/*_len index each mask's slice.  Areas are computed
+// once per mask instead of once per pair.
+void rle_iou_matrix(const uint32_t* dts, const int64_t* dt_off,
+                    const int64_t* dt_len, int64_t nd, const uint32_t* gts,
+                    const int64_t* gt_off, const int64_t* gt_len, int64_t ng,
+                    const uint8_t* iscrowd, double* out) {
+  std::vector<int64_t> adt((size_t)nd), agt((size_t)ng);
+  for (int64_t d = 0; d < nd; ++d)
+    adt[(size_t)d] = rle_area(dts + dt_off[d], dt_len[d]);
+  for (int64_t g = 0; g < ng; ++g)
+    agt[(size_t)g] = rle_area(gts + gt_off[g], gt_len[g]);
+  for (int64_t d = 0; d < nd; ++d) {
+    for (int64_t g = 0; g < ng; ++g) {
+      const int64_t inter = intersection_area(
+          dts + dt_off[d], dt_len[d], gts + gt_off[g], gt_len[g]);
+      const double denom =
+          iscrowd[g] ? (double)adt[(size_t)d]
+                     : (double)(adt[(size_t)d] + agt[(size_t)g] - inter);
+      out[d * ng + g] = denom > 0 ? (double)inter / denom : 0.0;
+    }
+  }
+}
+
 // Merge (union or intersection) of two RLEs over the same canvas.
 // counts_out capacity h*w+1; returns count.
 int64_t rle_merge(const uint32_t* a, int64_t ma, const uint32_t* b,

@@ -14,6 +14,7 @@ from typing import Dict
 from mx_rcnn_tpu.config import Config, generate_config
 from mx_rcnn_tpu.core.tester import Predictor, pred_eval
 from mx_rcnn_tpu.data import TestLoader, load_gt_roidb
+from mx_rcnn_tpu.tools.train import add_set_arg, parse_set_overrides
 from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.utils.checkpoint import load_param
 
@@ -82,9 +83,7 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="pickle raw detections here for tools/reeval.py")
     p.add_argument("--num_devices", type=int, default=1,
                    help="shard eval batches over this many devices")
-    p.add_argument("--set", action="append", metavar="SEC__FIELD=VAL",
-                   help="override any config field, e.g. "
-                        "--set train__rpn_pre_nms_top_n=6000 (repeatable)")
+    add_set_arg(p)
     return p.parse_args(argv)
 
 
@@ -97,6 +96,7 @@ def main(argv=None):
         overrides["dataset__root_path"] = args.root_path
     if args.dataset_path:
         overrides["dataset__dataset_path"] = args.dataset_path
+    overrides.update(parse_set_overrides(args))
     cfg = generate_config(args.network, args.dataset, **overrides)
     test_rcnn(cfg, prefix=args.prefix, epoch=args.epoch,
               image_set=args.image_set, out_dir=args.out_dir,

@@ -12,6 +12,7 @@ import logging
 
 from mx_rcnn_tpu.data import load_gt_roidb
 from mx_rcnn_tpu.tools.train_alternate import _dump_proposals
+from mx_rcnn_tpu.tools.train import add_set_arg
 from mx_rcnn_tpu.tools.train_rpn import stage_config
 
 logger = logging.getLogger("mx_rcnn_tpu")
@@ -33,8 +34,7 @@ def main(argv=None):
     p.add_argument("--epoch", type=int, required=True)
     p.add_argument("--out", required=True, help="output proposal pkl path")
     p.add_argument("--no_flip", action="store_true")
-    p.add_argument("--set", action="append", metavar="SEC__FIELD=VAL",
-                   help="override any config field (repeatable)")
+    add_set_arg(p)
     args = p.parse_args(argv)
     cfg = stage_config(args)
     # proposals are generated over the TRAIN roidb (flip-augmented unless

@@ -149,6 +149,32 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
     return state
 
 
+def add_set_arg(p) -> None:
+    """Register the generic config-override flag (shared by every CLI)."""
+    p.add_argument("--set", action="append", metavar="SEC__FIELD=VAL",
+                   help="override any config field, e.g. "
+                        "--set train__rpn_pre_nms_top_n=6000 (repeatable); "
+                        "values parse as Python literals (strings/bools "
+                        "coerced to the field's type)")
+
+
+def parse_set_overrides(args) -> dict:
+    """--set section__field=value items → generate_config overrides."""
+    import ast
+
+    overrides = {}
+    for item in getattr(args, "set", None) or []:
+        key, sep, val = item.partition("=")
+        if not sep or "__" not in key:
+            raise ValueError(
+                f"--set expects section__field=value, got {item!r}")
+        try:
+            overrides[key] = ast.literal_eval(val)
+        except (ValueError, SyntaxError):
+            overrides[key] = val
+    return overrides
+
+
 def config_from_args(args) -> Config:
     """Build the config from common dataset/train CLI flags.
 
@@ -169,20 +195,7 @@ def config_from_args(args) -> Config:
         overrides["train__flip"] = False
     if getattr(args, "no_shuffle", False):
         overrides["train__shuffle"] = False
-    # generic escape hatch: --set section__field=value (repeatable) exposes
-    # every Config field the way the reference exposes its config module —
-    # values parse as Python literals, falling back to plain strings
-    import ast
-
-    for item in getattr(args, "set", None) or []:
-        key, _, val = item.partition("=")
-        if not _ or "__" not in key:
-            raise ValueError(
-                f"--set expects section__field=value, got {item!r}")
-        try:
-            overrides[key] = ast.literal_eval(val)
-        except (ValueError, SyntaxError):
-            overrides[key] = val
+    overrides.update(parse_set_overrides(args))
     return generate_config(args.network, args.dataset, **overrides)
 
 
@@ -223,9 +236,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--profile_dir", default=None,
                    help="capture a jax.profiler trace of early steps here")
-    p.add_argument("--set", action="append", metavar="SEC__FIELD=VAL",
-                   help="override any config field, e.g. "
-                        "--set train__rpn_pre_nms_top_n=6000 (repeatable)")
+    add_set_arg(p)
     p.add_argument("--device_cache", action="store_true",
                    help="stage the epoch in HBM and gather batches on "
                         "device (single-bucket datasets; for hosts/links "

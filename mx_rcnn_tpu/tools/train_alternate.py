@@ -40,7 +40,8 @@ from mx_rcnn_tpu.core.tester import generate_proposals
 from mx_rcnn_tpu.core.train import TrainState
 from mx_rcnn_tpu.data import TestLoader, load_gt_roidb
 from mx_rcnn_tpu.models import build_model
-from mx_rcnn_tpu.tools.train import config_from_args, train_net
+from mx_rcnn_tpu.tools.train import (add_set_arg, config_from_args,
+                                     train_net)
 from mx_rcnn_tpu.utils.checkpoint import (combine_model, load_param,
                                           save_checkpoint)
 
@@ -168,9 +169,7 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="stage-2 init when --pretrained is absent (fresh "
                         "mirrors the ref's generic-weights semantics; "
                         "measured equivalent to rpn1 across seeds)")
-    p.add_argument("--set", action="append", metavar="SEC__FIELD=VAL",
-                   help="override any config field, e.g. "
-                        "--set train__rpn_pre_nms_top_n=6000 (repeatable)")
+    add_set_arg(p)
     return p.parse_args(argv)
 
 

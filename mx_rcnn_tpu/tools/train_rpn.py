@@ -12,7 +12,8 @@ from __future__ import annotations
 import argparse
 import logging
 
-from mx_rcnn_tpu.tools.train import config_from_args, train_net
+from mx_rcnn_tpu.tools.train import (add_set_arg, config_from_args,
+                                     train_net)
 
 logger = logging.getLogger("mx_rcnn_tpu")
 
@@ -27,9 +28,7 @@ def _stage_args(p: argparse.ArgumentParser, default_prefix: str) -> None:
     p.add_argument("--root_path", default=None)
     p.add_argument("--dataset_path", default=None)
     p.add_argument("--prefix", default=default_prefix)
-    p.add_argument("--set", action="append", metavar="SEC__FIELD=VAL",
-                   help="override any config field, e.g. "
-                        "--set train__rpn_pre_nms_top_n=6000 (repeatable)")
+    add_set_arg(p)
     p.add_argument("--pretrained", default=None)
     p.add_argument("--pretrained_epoch", type=int, default=0)
     p.add_argument("--init_from", default=None,

@@ -341,3 +341,42 @@ def test_config_from_args_set_overrides():
     with pytest.raises(ValueError, match="section__field"):
         config_from_args(argparse.Namespace(
             network="tiny", dataset="synthetic", set=["badkey"]))
+
+
+def test_set_override_type_coercion():
+    """--set values coerce to the field's declared type; bad types are
+    rejected loudly (the string 'false' must never become a truthy flag)."""
+    from mx_rcnn_tpu.config import generate_config
+
+    cfg = generate_config("tiny", "synthetic", train__shuffle="false")
+    assert cfg.train.shuffle is False
+    cfg = generate_config("tiny", "synthetic", train__shuffle="True")
+    assert cfg.train.shuffle is True
+    cfg = generate_config("tiny", "synthetic", default__e2e_lr="0.01")
+    assert cfg.default.e2e_lr == 0.01
+    cfg = generate_config("tiny", "synthetic",
+                          bucket__shapes=[[320, 416]])
+    assert cfg.bucket.shapes == ([320, 416],)
+    with pytest.raises(TypeError, match="expects a bool"):
+        generate_config("tiny", "synthetic", train__shuffle="maybe")
+    with pytest.raises(TypeError, match="expects an int"):
+        generate_config("tiny", "synthetic", train__batch_images="two")
+    with pytest.raises(TypeError, match="expects an int"):
+        generate_config("tiny", "synthetic", train__batch_images=1.5)
+
+
+def test_test_cli_consumes_set_overrides(tmp_path, monkeypatch):
+    """tools/test.py must actually APPLY --set overrides (regression: the
+    flag was once registered but ignored)."""
+    from mx_rcnn_tpu.tools import test as test_tool
+
+    seen = {}
+
+    def fake_test_rcnn(cfg, **kw):
+        seen["thresh"] = cfg.test.score_thresh
+        return {}
+
+    monkeypatch.setattr(test_tool, "test_rcnn", fake_test_rcnn)
+    test_tool.main(["--network", "tiny", "--dataset", "synthetic",
+                    "--epoch", "1", "--set", "test__score_thresh=0.25"])
+    assert seen["thresh"] == 0.25

@@ -215,3 +215,34 @@ def test_rle_string_codec_large_counts(has_native):
     rle = native.encode(m)
     np.testing.assert_array_equal(native.decode(rle), m)
     assert native.area(rle) == int(m.sum())
+
+
+@pytest.mark.parametrize("backend", ["native", "numpy"])
+def test_iou_matrix_matches_pairwise(backend, monkeypatch):
+    """Batched rle_iou_matrix == pairwise iou, incl. crowd columns, on
+    random masks; empty-side cases return empty matrices.  Runs on both
+    the native and the NumPy-fallback backend."""
+    from mx_rcnn_tpu import native
+
+    rng = np.random.RandomState(3)
+    h = w = 40
+
+    def rand_rle():
+        m = np.zeros((h, w), np.uint8)
+        x1, y1 = rng.randint(0, 25, 2)
+        m[y1:y1 + rng.randint(5, 15), x1:x1 + rng.randint(5, 15)] = 1
+        return native.encode(m)
+
+    dts = [rand_rle() for _ in range(5)]
+    gts = [rand_rle() for _ in range(4)]
+    crowd = np.array([False, True, False, True])
+    want = np.array([[native.iou(d, g, bool(c))
+                      for g, c in zip(gts, crowd)] for d in dts])
+    if backend == "numpy":
+        _numpy_backend(monkeypatch)
+    got = native.iou_matrix(dts, gts, crowd)
+    np.testing.assert_allclose(got, want, rtol=0, atol=0)
+    assert native.iou_matrix([], gts).shape == (0, 4)
+    assert native.iou_matrix(dts, []).shape == (5, 0)
+    with pytest.raises(ValueError, match="crowd flags"):
+        native.iou_matrix(dts, gts, [True])
