@@ -349,13 +349,18 @@ def _coerce_override(cur: Any, val: Any, key: str) -> Any:
         except (TypeError, ValueError):
             raise TypeError(f"{key} expects an int, got {val!r}")
     if isinstance(cur, float):
+        if isinstance(val, bool):
+            raise TypeError(f"{key} expects a float, got {val!r}")
         try:
             return float(val)
         except (TypeError, ValueError):
             raise TypeError(f"{key} expects a float, got {val!r}")
     if isinstance(cur, tuple):
         if isinstance(val, (list, tuple)):
-            return tuple(val)
+            # deep-convert so no mutable list nests inside the frozen
+            # config (shapes etc. are tuples of tuples)
+            return tuple(tuple(v) if isinstance(v, (list, tuple)) else v
+                         for v in val)
         raise TypeError(f"{key} expects a tuple/list, got {val!r}")
     if isinstance(cur, str) and not isinstance(val, str):
         raise TypeError(f"{key} expects a string, got {val!r}")

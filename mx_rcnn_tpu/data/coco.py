@@ -104,6 +104,64 @@ class COCODataset(IMDB):
             self._write_results_json(all_boxes, out_dir)
         return evaluate_bbox(dets, gts, list(range(1, self.num_classes)))
 
+    def ann_rle(self, a: dict, image_id: int) -> dict:
+        """An annotation's segmentation as a ``native`` RLE dict.
+
+        Handles all three COCO encodings (ref ``pycocotools.coco — annToRLE``):
+        polygon lists (union of ``from_poly`` fills), uncompressed RLE
+        (counts as an int list), and compressed RLE (counts string); falls
+        back to the bbox rectangle when no segmentation is present.
+        """
+        from mx_rcnn_tpu import native
+
+        info = self.images[image_id]
+        h, w = info["height"], info["width"]
+        seg = a.get("segmentation")
+        if isinstance(seg, list) and seg:
+            return native.merge(
+                [native.from_poly(p, h, w) for p in seg])
+        if isinstance(seg, dict):
+            counts = seg["counts"]
+            if isinstance(counts, list):  # uncompressed (crowd) RLE
+                return native.from_uncompressed(seg["size"], counts)
+            if isinstance(counts, str):
+                counts = counts.encode()
+            return {"size": list(seg["size"]), "counts": counts}
+        x, y, bw, bh = a["bbox"]
+        return native.from_bbox([x, y, bw, bh], h, w)
+
+    def evaluate_segmentations(self, dets_by_image_cat,
+                               out_dir: str = None) -> Dict[str, float]:
+        """COCO segm-mode AP over mask detections (the vendored
+        pycocotools' iouType='segm' path).
+
+        ``dets_by_image_cat``: image id → {class id → list of (rle, score)
+        pairs} in ``native`` RLE format.  Ground-truth masks come from the
+        annotations via :meth:`ann_rle` (crowds included as ignore
+        regions).  Returns the same metric dict as bbox eval.
+        """
+        from mx_rcnn_tpu.data.coco_eval import evaluate_segm
+
+        gts: Dict[int, dict] = {}
+        for image_id in self.image_index:
+            per_cat: Dict[int, dict] = {}
+            for a in self.anns_by_image.get(image_id, []):
+                c = self.cat_to_class[a["category_id"]]
+                e = per_cat.setdefault(c, {"rles": [], "iscrowd": [],
+                                           "area": []})
+                e["rles"].append(self.ann_rle(a, image_id))
+                e["iscrowd"].append(bool(a.get("iscrowd", 0)))
+                bw, bh = a["bbox"][2], a["bbox"][3]
+                e["area"].append(a.get("area", bw * bh))
+            gts[image_id] = {
+                c: {"rles": e["rles"],
+                    "iscrowd": np.asarray(e["iscrowd"], bool),
+                    "area": np.asarray(e["area"], float)}
+                for c, e in per_cat.items()
+            }
+        return evaluate_segm(dets_by_image_cat, gts,
+                             list(range(1, self.num_classes)))
+
     def _write_results_json(self, all_boxes, out_dir: str) -> None:
         """Standard COCO results format (xywh), ref coco results dumping."""
         results = []

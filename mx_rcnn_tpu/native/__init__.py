@@ -448,6 +448,13 @@ def from_poly(xy: Sequence[float], h: int, w: int) -> Dict:
         np.ascontiguousarray(mask.T.reshape(-1)), h, w)
 
 
+def from_uncompressed(size: Sequence[int], counts: Sequence[int]) -> Dict:
+    """COCO *uncompressed* RLE (counts as an int list, the crowd-annotation
+    json form) → compressed RLE dict (ref ``pycocotools — frUncompressedRLE``)."""
+    return {"size": list(size),
+            "counts": _counts_to_string(np.asarray(counts, np.uint32))}
+
+
 def from_bbox(bb: Sequence[float], h: int, w: int) -> Dict:
     """COCO (x, y, w, h) box → RLE."""
     x, y, bw, bh = (float(v) for v in bb)

@@ -662,3 +662,53 @@ def test_from_poly_close_to_independent_rasterizer():
         worst = min(worst, iou)
         assert union > 500, "degenerate polygon in fixture"
     assert worst > 0.92, f"from_poly deviates too much: worst IoU {worst}"
+
+
+def test_coco_dataset_segm_eval_end_to_end(tmp_path):
+    """COCODataset.evaluate_segmentations over all three COCO segmentation
+    encodings: polygon list, uncompressed crowd RLE, and bbox fallback.
+    A perfect detector (gt masks as detections) must score AP 1.0; the
+    crowd region must absorb a stray det instead of counting it as fp."""
+    from mx_rcnn_tpu import native
+
+    h, w = 80, 100
+    # gt mask 1: polygon rectangle ~ (10,10)-(40,30)
+    poly = [10.0, 10.0, 40.0, 10.0, 40.0, 30.0, 10.0, 30.0]
+    # crowd mask: uncompressed RLE of a 20x20 block at top-left corner
+    crowd_mask = np.zeros((h, w), np.uint8)
+    crowd_mask[0:20, 60:80] = 1
+    crowd_counts = [int(c) for c in
+                    np.asarray(native._counts_of(
+                        native.encode(crowd_mask)), np.uint32)]
+    ann = {
+        "images": [{"id": 1, "file_name": "a.jpg", "width": w, "height": h}],
+        "categories": [{"id": 5, "name": "thing"}],
+        "annotations": [
+            {"image_id": 1, "category_id": 5, "bbox": [10, 10, 31, 21],
+             "area": 651, "iscrowd": 0, "segmentation": [poly]},
+            {"image_id": 1, "category_id": 5, "bbox": [60, 0, 20, 20],
+             "area": 400, "iscrowd": 1,
+             "segmentation": {"size": [h, w], "counts": crowd_counts}},
+            # no segmentation → bbox-rectangle fallback
+            {"image_id": 1, "category_id": 5, "bbox": [50, 50, 10, 10],
+             "area": 100, "iscrowd": 0},
+        ],
+    }
+    ann_dir = tmp_path / "coco" / "annotations"
+    os.makedirs(ann_dir)
+    with open(ann_dir / "instances_val.json", "w") as f:
+        json.dump(ann, f)
+    ds = COCODataset("val", str(tmp_path), str(tmp_path / "coco"))
+
+    gt_rles = [ds.ann_rle(a, 1) for a in ds.anns_by_image[1]]
+    # sanity of each encoding path
+    assert native.area(gt_rles[1]) == 400            # uncompressed round-trip
+    assert native.area(gt_rles[2]) == 10 * 10        # bbox fallback
+    assert abs(native.area(gt_rles[0]) - 31 * 21) <= 70  # polygon fill
+
+    # perfect detector: the two real gt masks, plus one det inside the crowd
+    dets = {1: {1: [(gt_rles[0], 0.9), (gt_rles[2], 0.85),
+                    (gt_rles[1], 0.95)]}}
+    r = ds.evaluate_segmentations(dets)
+    assert r["AP"] == pytest.approx(1.0)
+    assert r["AR_100"] == pytest.approx(1.0)

@@ -356,7 +356,9 @@ def test_set_override_type_coercion():
     assert cfg.default.e2e_lr == 0.01
     cfg = generate_config("tiny", "synthetic",
                           bucket__shapes=[[320, 416]])
-    assert cfg.bucket.shapes == ([320, 416],)
+    assert cfg.bucket.shapes == ((320, 416),)  # deep tuple conversion
+    with pytest.raises(TypeError, match="expects a float"):
+        generate_config("tiny", "synthetic", default__e2e_lr=True)
     with pytest.raises(TypeError, match="expects a bool"):
         generate_config("tiny", "synthetic", train__shuffle="maybe")
     with pytest.raises(TypeError, match="expects an int"):
