@@ -114,8 +114,10 @@ def fit(
     deviation (disclosed): batch COMPOSITION is frozen at staging; epochs
     reshuffle batch ORDER on device (deterministically from ``key`` and
     the epoch number, so resume stays step-exact; with ``shuffle=False``
-    loaders the run is bit-identical to streaming).  v1 limits: requires a
-    single-bucket dataset and ``mesh=None``.
+    loaders the run is bit-identical to streaming).  Composes with a mesh:
+    the epoch shards over the data axes and every device gathers its slice
+    of each batch (``parallel.dp.make_dp_cached_step``).  Limit: requires
+    a single-bucket dataset.
     Mid-epoch RESUME is driven by ``state.step`` alone: if the incoming
     state is ``skip`` steps past ``begin_epoch``'s start, the first epoch
     skips its first ``skip`` batches; the deterministic per-epoch shuffle
@@ -125,26 +127,37 @@ def fit(
     frequent = cfg.default.frequent if frequent is None else frequent
     cache = None
     if device_cache:
-        if mesh is not None and mesh.size > 1:
-            raise ValueError("device_cache does not compose with a mesh yet")
         import jax.numpy as jnp
 
         from mx_rcnn_tpu.data.device_cache import (build_caches,
                                                    make_cached_step)
 
-        caches = build_caches(train_loader)
+        on_mesh = mesh is not None and mesh.size > 1
+        caches = build_caches(train_loader,
+                              mesh=mesh if on_mesh else None)
         if len(caches) != 1:
             raise ValueError(
                 f"device_cache needs a single-bucket dataset "
                 f"(got {len(caches)} buckets); use the streaming loader")
         cache = caches[0]
-        logger.info("device cache: %d batches staged in HBM (%.0f MB)",
-                    cache.num_batches, cache.nbytes / 1e6)
-        cstep = jax.jit(
-            make_cached_step(make_train_step(model, cfg, tx, mode=mode),
-                             cache.num_batches,
-                             shuffle=getattr(train_loader, "shuffle", True)),
-            donate_argnums=(0, 2))
+        shuffle = getattr(train_loader, "shuffle", True)
+        logger.info("device cache: %d batches staged in HBM (%.0f MB%s)",
+                    cache.num_batches, cache.nbytes / 1e6,
+                    f", sharded over {mesh.size} devices" if on_mesh else "")
+        if on_mesh:
+            from mx_rcnn_tpu.parallel.dp import (make_dp_cached_step,
+                                                 replicate)
+
+            cstep = make_dp_cached_step(model, cfg, tx, mesh,
+                                        cache.num_batches, shuffle=shuffle,
+                                        mode=mode)
+            state = replicate(state, mesh)
+        else:
+            cstep = jax.jit(
+                make_cached_step(
+                    make_train_step(model, cfg, tx, mode=mode),
+                    cache.num_batches, shuffle=shuffle),
+                donate_argnums=(0, 2))
         # the gather index IS the global step: restores (incl. mid-epoch
         # interrupts) resume the exact batch sequence with no bookkeeping
         idx_box = [jnp.asarray(jax.device_get(state.step), jnp.int32)]

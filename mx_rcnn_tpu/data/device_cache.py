@@ -50,6 +50,8 @@ class DeviceEpochCache:
             raise ValueError(f"mixed bucket shapes in one cache: {shapes}")
         stacked = jax.tree.map(lambda *xs: np.stack(xs), *batches)
         self.num_batches = len(batches)
+        # ``device`` may be a Device or a Sharding (multi-chip: shard each
+        # batch's image axis over the mesh — axis 1 of the stacked layout)
         self.data = (jax.device_put(stacked, device) if device is not None
                      else jax.device_put(stacked))
         self.nbytes = sum(x.nbytes for x in jax.tree.leaves(stacked))
@@ -61,10 +63,22 @@ class DeviceEpochCache:
         return jnp.zeros((), jnp.int32)
 
 
-def build_caches(loader, max_bytes: int = 4 << 30) -> List[DeviceEpochCache]:
+def build_caches(loader, max_bytes: int = 4 << 30,
+                 mesh=None) -> List[DeviceEpochCache]:
     """Materialize one epoch from ``loader`` and upload it, grouped by
     bucket shape.  Raises if the epoch exceeds ``max_bytes`` (caller falls
-    back to the streaming loader)."""
+    back to the streaming loader).  With ``mesh``, each batch's image axis
+    is sharded over the mesh's data axes (every device holds its slice of
+    every batch — the multi-chip layout for :func:`make_dp_cached_step`),
+    and ``max_bytes`` bounds the PER-DEVICE footprint."""
+    placement = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from mx_rcnn_tpu.parallel.dp import data_axes
+
+        placement = NamedSharding(mesh, P(None, data_axes(mesh)))
+        max_bytes *= mesh.size
     by_shape = {}
     total = 0
     for b in loader:
@@ -74,7 +88,8 @@ def build_caches(loader, max_bytes: int = 4 << 30) -> List[DeviceEpochCache]:
             raise MemoryError(
                 f"epoch exceeds device cache budget ({total} > {max_bytes} "
                 f"bytes); use the streaming loader")
-    return [DeviceEpochCache(bs) for bs in by_shape.values()]
+    return [DeviceEpochCache(bs, device=placement)
+            for bs in by_shape.values()]
 
 
 def make_cached_step(base_step: Callable, num_batches: int,

@@ -13,6 +13,7 @@ the same program over a larger mesh (DCN axis between slices).
 
 from mx_rcnn_tpu.parallel.dp import (  # noqa: F401
     device_mesh,
+    make_dp_cached_step,
     make_dp_train_step,
     shard_batch,
     replicate,

@@ -82,6 +82,21 @@ def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 
+def _folded_step(model: FasterRCNN, cfg: Config, tx, axes, mode: str):
+    """The per-shard step body shared by the streaming and cached DP paths:
+    decorrelates per-image sampling RNG across mesh positions.  For a 2-D
+    (dcn, ici) mesh ``axis_index`` over both axes is the linearized
+    position, so an N-device run gives identical per-image keys regardless
+    of the mesh factorization."""
+    base = make_train_step(model, cfg, tx, axis_name=axes, mode=mode)
+
+    def shard_fn(state: TrainState, batch: Batch, key: jax.Array):
+        key = jax.random.fold_in(key, jax.lax.axis_index(axes))
+        return base(state, batch, key)
+
+    return shard_fn
+
+
 def make_dp_train_step(model: FasterRCNN, cfg: Config, tx, mesh: Mesh,
                        mode: str = "e2e"):
     """Jitted SPMD train step over ``mesh``.
@@ -93,15 +108,7 @@ def make_dp_train_step(model: FasterRCNN, cfg: Config, tx, mesh: Mesh,
     ``core.train.make_train_step``.
     """
     axes = data_axes(mesh)
-    base = make_train_step(model, cfg, tx, axis_name=axes, mode=mode)
-
-    def shard_fn(state: TrainState, batch: Batch, key: jax.Array):
-        # decorrelate per-image sampling RNG across mesh positions; for a
-        # 2-D (dcn, ici) mesh axis_index over both axes is the linearized
-        # position, so an N-device run gives identical per-image keys
-        # regardless of the mesh factorization
-        key = jax.random.fold_in(key, jax.lax.axis_index(axes))
-        return base(state, batch, key)
+    shard_fn = _folded_step(model, cfg, tx, axes, mode)
 
     sharded = jax.shard_map(
         shard_fn,
@@ -112,3 +119,34 @@ def make_dp_train_step(model: FasterRCNN, cfg: Config, tx, mesh: Mesh,
     )
     # donate the replicated state: in-place HBM update, no per-step copy
     return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_dp_cached_step(model: FasterRCNN, cfg: Config, tx, mesh: Mesh,
+                        num_batches: int, shuffle: bool = True,
+                        mode: str = "e2e"):
+    """SPMD train step fed from a mesh-sharded HBM epoch cache
+    (``data/device_cache.py`` with ``build_caches(..., mesh=mesh)``).
+
+    Signature matches ``make_cached_step``'s wrapping —
+    ``(replicated state, sharded epoch data, replicated idx, replicated
+    key) -> (state, idx+1, metrics)`` — but runs under ``shard_map``: each
+    device gathers ITS slice of the selected batch from its local shard
+    (the epoch is laid out ``P(None, data_axes)``, so the batch-index
+    gather on axis 0 is shard-local), RNG decorrelates per mesh position,
+    and gradients pmean over all mesh axes inside the step.  The epoch
+    permutation draws from the replicated key, so every device picks the
+    same batch index.
+    """
+    from mx_rcnn_tpu.data.device_cache import make_cached_step
+
+    axes = data_axes(mesh)
+    cached = make_cached_step(_folded_step(model, cfg, tx, axes, mode),
+                              num_batches, shuffle=shuffle)
+    sharded = jax.shard_map(
+        cached,
+        mesh=mesh,
+        in_specs=(P(), P(None, axes), P(), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0, 2))

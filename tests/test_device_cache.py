@@ -135,11 +135,10 @@ def test_fit_with_device_cache_matches_streaming(tmp_path):
         s_stream.params, s_cached.params)
 
 
-def test_fit_device_cache_rejects_mesh_and_multibucket(tmp_path):
+def test_fit_device_cache_rejects_multibucket(tmp_path):
     from mx_rcnn_tpu.core.fit import fit
     from mx_rcnn_tpu.data.loader import AnchorLoader
     from mx_rcnn_tpu.data.synthetic import SyntheticDataset
-    from mx_rcnn_tpu.parallel.dp import device_mesh
 
     cfg = generate_config("tiny", "synthetic")
     cfg = cfg.replace_in("train", batch_images=1)
@@ -156,8 +155,53 @@ def test_fit_device_cache_rejects_mesh_and_multibucket(tmp_path):
     bh, bw = cfg.bucket.shapes[0]
     state, tx = setup_training(model, cfg, key, (1, bh, bw, 3),
                                steps_per_epoch=4)
-    with pytest.raises(ValueError, match="mesh"):
-        fit(model, cfg, state, tx, loader, 1, key,
-            mesh=device_mesh(8), device_cache=True)
     with pytest.raises(ValueError, match="bucket"):
         fit(model, cfg, state, tx, loader, 1, key, device_cache=True)
+
+
+@pytest.mark.slow
+def test_dp_cached_step_matches_dp_streaming(tmp_path):
+    """Mesh x device_cache: the sharded-epoch cached step must reproduce
+    the streaming DP step bitwise (shuffle off) on the 8-device mesh."""
+    from mx_rcnn_tpu.data.device_cache import build_caches
+    from mx_rcnn_tpu.data.loader import AnchorLoader
+    from mx_rcnn_tpu.data.synthetic import SyntheticDataset
+    from mx_rcnn_tpu.parallel.dp import (device_mesh, make_dp_cached_step,
+                                         make_dp_train_step, replicate,
+                                         shard_batch)
+
+    cfg = generate_config("tiny", "synthetic")
+    cfg = cfg.replace_in("train", batch_images=1, rpn_pre_nms_top_n=64,
+                         rpn_post_nms_top_n=16, batch_rois=8, max_gt_boxes=8,
+                         rpn_batch_size=16, rpn_min_size=2)
+    ds = SyntheticDataset("train", str(tmp_path), "", num_images=16,
+                          image_size=(120, 160))
+    roidb = ds.gt_roidb()
+    mesh = device_mesh(8)
+    # global batch = 8 devices x 1 image
+    loader = AnchorLoader(roidb, cfg, batch_images=8, shuffle=False,
+                          num_workers=0)
+    model = build_model(cfg)
+    key = jax.random.PRNGKey(0)
+    bh, bw = cfg.bucket.shapes[0]
+    state, tx = setup_training(model, cfg, key, (1, bh, bw, 3),
+                               steps_per_epoch=len(loader))
+
+    stream = make_dp_train_step(model, cfg, tx, mesh)
+    s_stream = replicate(jax.tree.map(jnp.copy, state), mesh)
+    for b in loader:
+        s_stream, m_stream = stream(s_stream, shard_batch(b, mesh), key)
+
+    cache = build_caches(loader, mesh=mesh)[0]
+    cstep = make_dp_cached_step(model, cfg, tx, mesh, cache.num_batches,
+                                shuffle=False)
+    s_cache = replicate(state, mesh)
+    idx = cache.index_handle()
+    for _ in range(cache.num_batches):
+        s_cache, idx, m_cache = cstep(s_cache, cache.data, idx, key)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        s_stream.params, s_cache.params)
+    np.testing.assert_array_equal(np.asarray(m_stream["loss"]),
+                                  np.asarray(m_cache["loss"]))
