@@ -80,17 +80,23 @@ def test_resume_continues_training(tmp_path):
     assert int(state.step) == 3 * steps_per_epoch
 
 
-def test_sigterm_interrupt_resume_bit_exact(tmp_path):
+@pytest.mark.parametrize("device_cache", [False, True])
+def test_sigterm_interrupt_resume_bit_exact(tmp_path, device_cache):
     """Preemption path: stop mid-epoch via stop_flag, restore the interrupt
     checkpoint with --resume semantics, continue — final params must be
     BIT-IDENTICAL to an uninterrupted run (deterministic shuffle + RNG
-    folded on state.step make mid-epoch resume exact)."""
+    folded on state.step make mid-epoch resume exact).  Runs for both the
+    streaming loader and the HBM epoch cache (whose gather index IS
+    state.step, so the restored run replays the exact batch sequence and
+    the epoch-keyed on-device shuffle is deterministic across the
+    interruption)."""
     import jax
 
     from mx_rcnn_tpu.utils.checkpoint import interrupt_path
 
     cfg = _cfg(tmp_path)
-    kw = dict(end_epoch=2, lr=0.001, dataset_kw=TRAIN_KW, seed=3)
+    kw = dict(end_epoch=2, lr=0.001, dataset_kw=TRAIN_KW, seed=3,
+              device_cache=device_cache)
 
     # uninterrupted reference run
     ref = train_net(cfg, prefix=str(tmp_path / "m" / "ref"), **kw)
@@ -111,6 +117,7 @@ def test_sigterm_interrupt_resume_bit_exact(tmp_path):
     assert not os.path.exists(interrupt_path(prefix))  # superseded
 
     assert int(final.step) == int(ref.step)
+    assert jax.tree.structure(ref.params) == jax.tree.structure(final.params)
     for a, b in zip(jax.tree.leaves(ref.params),
                     jax.tree.leaves(final.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
@@ -140,3 +147,4 @@ def test_stop_on_last_batch_of_epoch_writes_epoch_checkpoint(tmp_path):
                       lr=0.001, dataset_kw=TRAIN_KW, seed=1)
     assert int(final.step) == 64
     assert os.path.exists(checkpoint_path(prefix, 2))
+
