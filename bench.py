@@ -36,9 +36,38 @@ def bench_loader(loader) -> float:
     return n / dt
 
 
+def _transient(e: Exception) -> bool:
+    """Errors worth retrying on the tunneled chip; real configuration
+    errors (unknown backend, bad flags) must surface immediately."""
+    msg = str(e)
+    return ("Unavailable" in msg or "UNAVAILABLE" in msg
+            or "remote_compile" in msg or "response body" in msg)
+
+
+def _wait_for_device(max_wait_s: float = 300.0):
+    """The tunneled chip intermittently reports 'TPU backend setup/compile
+    error (Unavailable)'; retry backend init for a few minutes before
+    giving up so a transient outage doesn't void the whole benchmark."""
+    import jax
+
+    deadline = time.monotonic() + max_wait_s
+    while True:
+        try:
+            return jax.devices()
+        except RuntimeError as e:
+            if time.monotonic() > deadline or not _transient(e):
+                raise
+            first = (str(e).splitlines() or [""])[0][:80]
+            print(f"device unavailable ({first}); retrying...",
+                  file=sys.stderr)
+            time.sleep(20.0)
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
+
+    print(f"devices: {_wait_for_device()}", file=sys.stderr)
 
     from mx_rcnn_tpu.config import generate_config
     from mx_rcnn_tpu.core.train import make_train_step, setup_training
@@ -79,7 +108,19 @@ def main() -> None:
 
     print("compiling + warmup...", file=sys.stderr)
     t0 = time.perf_counter()
-    for _ in range(3):
+    # the donated state needs a fresh copy per retry attempt
+    for attempt in range(3):
+        try:
+            s2, metrics = step(jax.tree.map(jnp.copy, state), batch, key)
+            fetch(metrics["loss"])
+            state = s2
+            break
+        except Exception as e:
+            if attempt == 2 or not _transient(e):
+                raise
+            print(f"warmup retry ({e})", file=sys.stderr)
+            time.sleep(10.0)
+    for _ in range(2):
         state, metrics = step(state, batch, key)
     fetch(metrics["loss"])
     print(f"warmup done in {time.perf_counter() - t0:.1f}s; "
@@ -147,9 +188,7 @@ def main() -> None:
                     fetch(metrics["loss"])
                     break
                 except Exception as e:
-                    transient = ("remote_compile" in str(e)
-                                 or "response body" in str(e))
-                    if attempt == 2 or not transient:
+                    if attempt == 2 or not _transient(e):
                         raise
                     print(f"cached-step warmup retry ({e})", file=sys.stderr)
                     time.sleep(5.0)
