@@ -197,6 +197,12 @@ def main() -> None:
                         raise
                     print(f"cached-step warmup retry ({e})", file=sys.stderr)
                     time.sleep(5.0)
+            # free the warmup epoch before staging the timed one: keeping
+            # both alive doubles resident HBM and tunnel upload for no
+            # benefit (advisor r3)
+            for leaf in jax.tree.leaves(epoch.data):
+                leaf.delete()
+            epoch = None
             # one-time staging cost (host assembly + upload of FRESH bytes;
             # the tunnel moves new data at ~11 MB/s, so this is the run's
             # fixed cost — disclosed, then amortized away by multi-epoch

@@ -18,6 +18,8 @@ grouped at the bottom of each dataclass and commented as such.
 from __future__ import annotations
 
 import dataclasses
+import types
+import typing
 from dataclasses import dataclass, field
 from typing import Any, Mapping, Sequence, Tuple
 
@@ -310,8 +312,15 @@ def generate_config(network: str = "resnet101", dataset: str = "PascalVOC",
     for section, kw in by_section.items():
         node = getattr(cfg, section, None)
         if node is not None:
+            # resolved type objects (not strings): get_type_hints evaluates
+            # the `from __future__ import annotations` strings against the
+            # module namespace, so every Optional/Union spelling works
+            try:
+                declared = typing.get_type_hints(type(node))
+            except Exception:  # unresolvable forward ref: fall back to cur
+                declared = {}
             kw = {f: _coerce_override(getattr(node, f, None), v,
-                                      f"{section}__{f}")
+                                      f"{section}__{f}", declared.get(f))
                   for f, v in kw.items()}
         cfg = cfg.replace_in(section, **kw)
     return cfg
@@ -321,17 +330,46 @@ _BOOL_STRINGS = {"true": True, "yes": True, "1": True,
                  "false": False, "no": False, "0": False}
 
 
-def _coerce_override(cur: Any, val: Any, key: str) -> Any:
-    """Coerce a config override to the field's existing type.
+def _synthetic_exemplar(tp: Any) -> Any:
+    """An exemplar value of a field's RESOLVED declared type, used to drive
+    coercion when the field's *current* value is None (advisor r3: keying
+    coercion off a None value silently skipped all type checks).  ``tp``
+    comes from ``typing.get_type_hints``, so Optional[X], Union[X, None]
+    and ``X | None`` all arrive as unions and unwrap uniformly.  Returns
+    None for types coercion doesn't handle."""
+    origin = typing.get_origin(tp)
+    union_kinds = (typing.Union, getattr(types, "UnionType", ()))
+    if origin in union_kinds:
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) != 1:
+            return None  # genuinely multi-typed field: store as-is
+        tp = args[0]
+        origin = typing.get_origin(tp)
+    if tp is tuple or origin is tuple:
+        return ()
+    return {bool: False, int: 0, float: 0.0, str: ""}.get(tp)
+
+
+def _coerce_override(cur: Any, val: Any, key: str,
+                     annotation: Any = None) -> Any:
+    """Coerce a config override to the field's declared type.
 
     Frozen dataclasses do no type checking, and CLI ``--set`` values may
     arrive as strings (``--set train__shuffle=false``) — without coercion
     the string "false" would be stored and read as truthy.  Unknown fields
-    (cur is None because getattr missed) pass through so replace_in can
-    raise its own error.
+    (cur is None AND no annotation, because getattr missed) pass through so
+    replace_in can raise its own error.  A known field whose current value
+    is None coerces against its declared annotation instead, so None
+    defaults still get type errors on bad literals.
     """
-    if cur is None or val is None:
+    if val is None:
         return val
+    if cur is None:
+        if annotation is None:
+            return val
+        cur = _synthetic_exemplar(annotation)
+        if cur is None:  # un-coercible declared type: store as-is
+            return val
     if isinstance(cur, bool):
         if isinstance(val, bool):
             return val

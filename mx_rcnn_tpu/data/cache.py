@@ -18,14 +18,16 @@ Design:
   (tmp + rename) so concurrent loader threads/processes never observe a
   torn file; repeat reads ride the OS page cache — exactly the "free" RAM
   this host has,
-* keys hash the absolute path + flip + geometry params, so one directory
-  safely serves multiple datasets/configs.
+* keys hash the absolute path + file mtime/size + flip + geometry params,
+  so one directory safely serves multiple datasets/configs and a replaced
+  source image invalidates its entry instead of serving stale pixels.
 
 Thread-safe: the loader's prefetch pool calls ``load`` concurrently.
 """
 
 from __future__ import annotations
 
+import glob
 import hashlib
 import os
 import threading
@@ -34,7 +36,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from mx_rcnn_tpu.data.image import compute_scale, load_resized_uint8
+from mx_rcnn_tpu.data.image import (bucket_fit, compute_scale,
+                                    load_resized_uint8)
 
 
 def plan_scale(height: int, width: int, scale: int, max_size: int,
@@ -42,13 +45,13 @@ def plan_scale(height: int, width: int, scale: int, max_size: int,
     """The im_scale ``load_resized_uint8`` will produce for an original of
     (height, width) — including the shrink-to-fit correction.  Pure
     function of geometry: cache hits get the exact scale the decode path
-    would have returned without touching pixels."""
+    would have returned without touching pixels.  Both the resize rule
+    (:func:`compute_scale`) and the shrink correction
+    (:func:`bucket_fit`) are the decode path's own helpers, so the two
+    computations cannot drift apart."""
     s = compute_scale(height, width, scale, max_size)
     rh, rw = int(round(height * s)), int(round(width * s))
-    bh, bw = bucket
-    if rh > bh or rw > bw:
-        s *= min(bh / rh, bw / rw)
-    return s
+    return s * bucket_fit(rh, rw, bucket)
 
 
 class DecodedImageCache:
@@ -74,13 +77,26 @@ class DecodedImageCache:
     @staticmethod
     def _key(path: str, flipped: bool, scale: int, max_size: int,
              bucket: Tuple[int, int]) -> str:
+        # Two-part key: a STABLE digest of path+geometry, then a VERSION
+        # suffix from the file's mtime_ns+size.  The version guarantees a
+        # re-generated/replaced source image can never be served stale
+        # pixels from cache_dir (advisor r3); the stable prefix lets the
+        # writer evict superseded versions so repeated dataset regeneration
+        # doesn't grow cache_dir unboundedly.  A missing file falls through
+        # to the decode path, which raises its own error.
+        try:
+            st = os.stat(path)
+            stamp = f"{st.st_mtime_ns}:{st.st_size}"
+        except OSError:
+            stamp = "0:0"
         ident = f"{os.path.abspath(path)}|{int(flipped)}|{scale}|" \
                 f"{max_size}|{bucket[0]}x{bucket[1]}"
         stem = os.path.splitext(os.path.basename(path))[0]
         # full-width digest: a truncated hash colliding would silently
         # serve another image's pixels
         digest = hashlib.sha1(ident.encode()).hexdigest()
-        return f"{digest}-{stem}{'-f' if flipped else ''}"
+        version = hashlib.sha1(stamp.encode()).hexdigest()[:16]
+        return f"{digest}-{stem}{'-f' if flipped else ''}.{version}"
 
     def _ram_get(self, key: str) -> Optional[np.ndarray]:
         with self._lock:
@@ -130,6 +146,21 @@ class DecodedImageCache:
                 with open(tmp, "wb") as f:
                     np.save(f, img)
                 os.replace(tmp, fp)
+                # evict superseded versions of this entry (same stable
+                # prefix, different mtime/size version) so regenerating the
+                # dataset N times doesn't keep N dead copies on disk; also
+                # the pre-versioning legacy name `prefix.npy`, which the
+                # new keys can never read again
+                prefix = key.rsplit(".", 1)[0]
+                pat = os.path.join(glob.escape(self.cache_dir),
+                                   glob.escape(prefix) + ".*.npy")
+                legacy = os.path.join(self.cache_dir, prefix + ".npy")
+                for old in glob.glob(pat) + [legacy]:
+                    if old != fp:
+                        try:
+                            os.unlink(old)
+                        except OSError:  # already gone / never existed
+                            pass
             except OSError:  # disk full etc. — the cache stays best-effort
                 if os.path.exists(tmp):
                     os.unlink(tmp)

@@ -69,6 +69,18 @@ def resize_keep_ratio(img: np.ndarray, target_size: int, max_size: int
     return out, scale
 
 
+def bucket_fit(h: int, w: int, bucket: Tuple[int, int]) -> float:
+    """Shrink factor that makes an (h, w) resized image fit ``bucket``
+    (1.0 when it already fits).  Single source of truth shared by the
+    decode path (:func:`load_resized_uint8`) and the cache's scale
+    predictor (``data/cache.py — plan_scale``) so the two can never
+    desync (advisor r3)."""
+    bh, bw = bucket
+    if h > bh or w > bw:
+        return min(bh / h, bw / w)
+    return 1.0
+
+
 def choose_bucket(h: int, w: int, buckets: Sequence[Tuple[int, int]]
                   ) -> Tuple[int, int]:
     """Pick the smallest bucket that fits (h, w); falls back to the bucket
@@ -105,9 +117,8 @@ def load_resized_uint8(
         img = img[:, ::-1, :]
     img, im_scale = resize_keep_ratio(img, scale, max_size)
     h, w = img.shape[:2]
-    bh, bw = bucket
-    if h > bh or w > bw:  # bucket smaller than resize target: shrink to fit
-        fit = min(bh / h, bw / w)
+    fit = bucket_fit(h, w, bucket)
+    if fit != 1.0:  # bucket smaller than resize target: shrink to fit
         new_w, new_h = int(w * fit), int(h * fit)
         if _HAS_CV2:
             img = cv2.resize(img, (new_w, new_h))

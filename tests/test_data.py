@@ -288,6 +288,71 @@ def test_decoded_image_cache_ram_and_disk(tmp_path):
     assert (flipped != got).any()
 
 
+def test_plan_scale_matches_decode_over_random_geometries(tmp_path):
+    """plan_scale must equal the im_scale the REAL decode path returns for
+    randomized (h, w, scale, max_size, bucket) combos — actually decoding
+    an image each time, so any future edit to load_resized_uint8's resize
+    arithmetic that desyncs the cached scale fails here (advisor r3)."""
+    from PIL import Image
+
+    from mx_rcnn_tpu.data.cache import plan_scale
+    from mx_rcnn_tpu.data.image import load_resized_uint8
+
+    rng = np.random.RandomState(0)
+    for i in range(25):
+        h = int(rng.randint(40, 500))
+        w = int(rng.randint(40, 500))
+        scale = int(rng.choice([120, 240, 400]))
+        max_size = int(rng.choice([200, 320, 640]))
+        bucket = (int(rng.choice([128, 256, 416])),
+                  int(rng.choice([128, 256, 416])))
+        flipped = bool(rng.randint(2))
+        p = tmp_path / f"g{i}.png"
+        Image.fromarray(rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+                        ).save(p)
+        img, s_decode = load_resized_uint8(str(p), flipped, scale, max_size,
+                                           bucket)
+        s_plan = plan_scale(h, w, scale, max_size, bucket)
+        assert s_plan == s_decode, (h, w, scale, max_size, bucket, flipped)
+        # and the decoded image always fits the bucket
+        assert img.shape[0] <= bucket[0] and img.shape[1] <= bucket[1]
+
+
+def test_cache_invalidates_on_source_file_change(tmp_path):
+    """Replacing a source image must invalidate its disk-cache entry
+    (advisor r3: the key previously hashed only path + geometry)."""
+    from PIL import Image
+
+    from mx_rcnn_tpu.data.cache import DecodedImageCache
+
+    p = tmp_path / "img.png"
+    a = np.full((40, 60, 3), 10, np.uint8)
+    Image.fromarray(a).save(p)
+    cache = DecodedImageCache(ram_bytes=0, cache_dir=str(tmp_path / "c"))
+    got = cache.load(str(p), False, 32, 64, (32, 64))
+    assert got.mean() > 5
+    # replace the file with different pixels (force a distinct mtime_ns)
+    b = np.full((40, 60, 3), 200, np.uint8)
+    Image.fromarray(b).save(p)
+    os.utime(p, ns=(1, 1))
+    got2 = cache.load(str(p), False, 32, 64, (32, 64))
+    assert got2.mean() > 100, "stale cache entry served after file change"
+    assert cache.misses == 2
+    # the superseded on-disk version was evicted, not orphaned
+    import glob as _glob
+    assert len(_glob.glob(str(tmp_path / "c" / "*.npy"))) == 1
+    # a pre-versioning legacy file (digest-stem.npy, no version segment)
+    # is also swept when its entry is rewritten
+    cur = _glob.glob(str(tmp_path / "c" / "*.npy"))[0]
+    stable = os.path.basename(cur).rsplit(".", 2)[0]
+    legacy = tmp_path / "c" / (stable + ".npy")
+    legacy.write_bytes(b"old-format")
+    os.utime(p, ns=(2, 2))  # force yet another version
+    cache.load(str(p), False, 32, 64, (32, 64))
+    assert not legacy.exists(), "legacy versionless entry not evicted"
+    assert len(_glob.glob(str(tmp_path / "c" / "*.npy"))) == 1
+
+
 def test_cached_loader_identical_batches(tmp_path):
     """A cache-backed loader must yield batches identical to the direct
     loader, epoch after epoch (including flip keys)."""
@@ -365,6 +430,36 @@ def test_set_override_type_coercion():
         generate_config("tiny", "synthetic", train__batch_images="two")
     with pytest.raises(TypeError, match="expects an int"):
         generate_config("tiny", "synthetic", train__batch_images=1.5)
+
+
+def test_coerce_override_none_current_uses_annotation():
+    """A known field whose CURRENT value is None must still coerce/reject
+    by its declared (resolved) type (advisor r3: None used to skip all
+    type checks); unknown fields (no annotation) still pass through."""
+    from typing import Optional, Tuple, Union
+
+    from mx_rcnn_tpu.config import _coerce_override
+
+    assert _coerce_override(None, "false", "s__f", bool) is False
+    assert _coerce_override(None, "7", "s__f", int) == 7
+    assert _coerce_override(None, "0.5", "s__f", float) == 0.5
+    assert _coerce_override(None, [[1, 2]], "s__f",
+                            Tuple[Tuple[int, int], ...]) == ((1, 2),)
+    assert _coerce_override(None, "x", "s__f", Optional[str]) == "x"
+    # every Optional/Union spelling resolves to the same union form
+    assert _coerce_override(None, "3", "s__f", Optional[int]) == 3
+    assert _coerce_override(None, "3", "s__f", Union[int, None]) == 3
+    assert _coerce_override(None, "3", "s__f", eval("int | None")) == 3
+    with pytest.raises(TypeError, match="expects an int"):
+        _coerce_override(None, "two", "s__f", Optional[int])
+    with pytest.raises(TypeError, match="expects a bool"):
+        _coerce_override(None, "maybe", "s__f", bool)
+    # genuinely multi-typed union: stored as-is (no exemplar)
+    assert _coerce_override(None, "raw", "s__f", Union[int, str]) == "raw"
+    # unknown field: passes through so replace_in raises its own error
+    assert _coerce_override(None, "raw", "s__f", None) == "raw"
+    # None value always passes through (meaning "unset")
+    assert _coerce_override(None, None, "s__f", int) is None
 
 
 def test_test_cli_consumes_set_overrides(tmp_path, monkeypatch):
