@@ -3,6 +3,19 @@
 Prints ONE JSON line:
   {"metric": "imgs_per_sec_per_chip", "value": N, "unit": "imgs/s", "vs_baseline": N}
 
+Outage protocol (VERDICT r03 item 1): the tunneled chip can hang during
+backend init or go Unavailable for hours; round 3's bench died with a bare
+traceback and produced no number.  The default entry point is therefore a
+SUPERVISOR that runs the measurement in a fresh subprocess per attempt
+(``bench.py --once``) with a hard per-attempt timeout (a hung backend init
+cannot wedge the run), retries transient failures with backoff across a
+long window (``BENCH_RETRY_WINDOW_S``, default 3 h), and — if the window
+closes without a measurement — emits a STRUCTURED degraded line instead of
+a traceback: the last independently verified numbers plus
+``"degraded": true``, ``"failure"`` and ``"value_source"`` so the record
+is honest about its provenance.  Non-transient child errors (real bugs)
+bail to the degraded line immediately instead of burning the window.
+
 Baseline (BASELINE.md): the reference's community-reported throughput on a
 P100-class GPU for ResNet-101 @ short-side 600 is ~2-4 img/s; the north star
 is >= 1x P100 imgs/sec/chip, so vs_baseline is measured against 3.0 img/s
@@ -26,10 +39,21 @@ gains a "sustained_imgs_per_sec" key (VERDICT r02 item 1).
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+# Last independently verified numbers, reported (with provenance) only on
+# the degraded path when no live measurement could be captured.
+_LAST_VERIFIED = {
+    "value": 74.8,              # BENCH_r02.json — driver-captured
+    "sustained": 72.7,          # docs/PERF.md r3 in-session (device-rate)
+    "source": ("last verified: BENCH_r02 driver capture (74.8 imgs/s); "
+               "sustained from docs/PERF.md round-3 in-session run"),
+}
 
 
 def bench_loader(loader) -> float:
@@ -68,7 +92,8 @@ def _wait_for_device(max_wait_s: float = 300.0):
             time.sleep(20.0)
 
 
-def main() -> None:
+def run_once() -> None:
+    """One full measurement attempt (runs in a fresh subprocess)."""
     import jax
     import jax.numpy as jnp
 
@@ -242,5 +267,131 @@ def main() -> None:
     print(json.dumps(out))
 
 
+def _parse_result(stdout: str):
+    """The child's result is its last stdout line iff it parses as a JSON
+    object with the expected metric key."""
+    lines = [ln for ln in stdout.strip().splitlines() if ln.strip()]
+    if not lines:
+        return None
+    try:
+        obj = json.loads(lines[-1])
+    except json.JSONDecodeError:
+        return None
+    return obj if isinstance(obj, dict) and "metric" in obj else None
+
+
+def _degraded(failure: str) -> dict:
+    return {
+        "metric": "imgs_per_sec_per_chip",
+        "value": _LAST_VERIFIED["value"],
+        "unit": "imgs/s",
+        "vs_baseline": round(_LAST_VERIFIED["value"] / 3.0, 3),
+        "sustained_imgs_per_sec": _LAST_VERIFIED["sustained"],
+        "degraded": True,
+        "value_source": _LAST_VERIFIED["source"],
+        "failure": failure[:500],
+    }
+
+
+def _run_attempt(cmd, timeout: float):
+    """Run one child, streaming its stderr through LIVE (an operator must
+    be able to tell a hung backend from a slow warmup) while keeping a tail
+    for failure classification.  Returns (rc, stdout, tail, timed_out);
+    rc is None when the child had to be killed at the timeout."""
+    import collections
+    import threading
+
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    tail: "collections.deque[str]" = collections.deque(maxlen=40)
+    out_chunks = []
+
+    def pump(stream, sink):
+        for line in stream:
+            sink(line)
+
+    def err_sink(line):
+        sys.stderr.write(line)
+        tail.append(line.rstrip("\n"))
+
+    threads = [threading.Thread(target=pump, args=(proc.stderr, err_sink),
+                                daemon=True),
+               threading.Thread(target=pump,
+                                args=(proc.stdout, out_chunks.append),
+                                daemon=True)]
+    for t in threads:
+        t.start()
+    timed_out = False
+    try:
+        proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        proc.kill()
+        proc.wait()
+    for t in threads:
+        t.join(timeout=5.0)
+    return proc.returncode, "".join(out_chunks), "\n".join(tail), timed_out
+
+
+def supervise(child_cmd=None) -> dict:
+    """Run measurement attempts in fresh subprocesses until one succeeds,
+    the retry window closes, or a non-transient error appears.  Returns the
+    dict to print (never raises).  ``child_cmd`` is overridable for tests.
+    """
+    window = float(os.environ.get("BENCH_RETRY_WINDOW_S", "10800"))
+    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "2400"))
+    deadline = time.monotonic() + window
+    cmd = child_cmd or [sys.executable, os.path.abspath(__file__), "--once"]
+    attempt = 0
+    while True:
+        attempt += 1
+        rc, stdout, tail, timed_out = _run_attempt(cmd, attempt_timeout)
+        result = _parse_result(stdout)
+        if result is not None:
+            # accept even from a killed/failed child: run_once prints its
+            # JSON only after a complete measurement, so a child that hung
+            # in TEARDOWN (the tunnel's known pathology) still measured
+            return result
+        if timed_out:
+            # a hung backend init — round 3's actual failure mode
+            last_failure = (f"attempt {attempt} exceeded the "
+                            f"{attempt_timeout:.0f}s per-attempt timeout "
+                            f"(hung backend?)")
+            transient = True
+        else:
+            last_failure = f"attempt {attempt} rc={rc}: " + tail[-400:]
+            # signal deaths (rc<0: OOM-kill, runtime abort) and silent
+            # crashes carry no diagnosable message — treat as environment
+            # trouble and keep retrying; only a recognizable non-transient
+            # Python error (ImportError etc.) stops burning the window
+            transient = (rc is None or rc < 0 or not tail.strip()
+                         or _transient(tail))
+        print(f"bench: {last_failure.splitlines()[0][:120]}",
+              file=sys.stderr)
+        if not transient:
+            print("bench: error looks non-transient; not retrying",
+                  file=sys.stderr)
+            return _degraded(last_failure)
+        # escalating backoff, capped; a fast crash-loop still paces itself
+        backoff = min(300.0, 15.0 * attempt)
+        remaining = deadline - time.monotonic()
+        if remaining <= backoff + 30.0:
+            # not enough window left for a sleep AND a meaningful attempt —
+            # don't overshoot the window by another full attempt_timeout
+            print("bench: retry window exhausted", file=sys.stderr)
+            return _degraded(last_failure)
+        print(f"bench: retrying in {backoff:.0f}s "
+              f"({remaining:.0f}s left in window)", file=sys.stderr)
+        time.sleep(backoff)
+
+
+def main() -> None:
+    if "--once" in sys.argv:
+        run_once()
+    else:
+        print(json.dumps(supervise()))
+
+
 if __name__ == "__main__":
     main()
+

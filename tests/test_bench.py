@@ -1,0 +1,165 @@
+"""bench.py outage protocol (VERDICT r03 item 1).
+
+Round 3 ended with ``BENCH_r03.json rc=1, parsed=null``: the tunneled chip
+hung during backend init and the bench died with a bare traceback.  These
+tests pin the supervisor contract with FAKE child commands — no device:
+
+* a healthy child's JSON line passes through unchanged,
+* a transiently failing child is retried in a fresh process and the later
+  success wins,
+* a hung child is killed at the per-attempt timeout and retried,
+* when the retry window closes (or the error is non-transient) the
+  supervisor emits a structured degraded line — never a traceback.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+import bench  # noqa: E402
+
+
+GOOD = {"metric": "imgs_per_sec_per_chip", "value": 75.0,
+        "unit": "imgs/s", "vs_baseline": 25.0}
+
+
+def _child(script: str):
+    return [sys.executable, "-c", script]
+
+
+def test_success_passes_through(monkeypatch):
+    monkeypatch.setenv("BENCH_RETRY_WINDOW_S", "60")
+    out = bench.supervise(_child(
+        f"import json; print('noise'); print(json.dumps({GOOD!r}))"))
+    assert out == GOOD
+    assert "degraded" not in out
+
+
+def test_transient_failure_then_success(tmp_path, monkeypatch):
+    """First attempt dies with an Unavailable error; the retry (a FRESH
+    process) succeeds.  State crosses attempts via a marker file."""
+    monkeypatch.setenv("BENCH_RETRY_WINDOW_S", "120")
+    marker = tmp_path / "tried"
+    script = (
+        "import json, os, sys\n"
+        f"m = {str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').close()\n"
+        "    sys.stderr.write('TPU backend setup/compile error "
+        "(Unavailable)\\n')\n"
+        "    sys.exit(1)\n"
+        f"print(json.dumps({GOOD!r}))\n"
+    )
+    # shrink the backoff so the test is fast
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    out = bench.supervise(_child(script))
+    assert out == GOOD
+    assert marker.exists()
+
+
+def test_hung_child_is_killed_and_degraded(monkeypatch):
+    """A child that never returns (round 3's hanging backend init) is
+    killed at the attempt timeout; with the window closed the supervisor
+    emits the structured degraded line."""
+    monkeypatch.setenv("BENCH_RETRY_WINDOW_S", "0")
+    monkeypatch.setenv("BENCH_ATTEMPT_TIMEOUT_S", "2")
+    out = bench.supervise(_child("import time; time.sleep(600)"))
+    assert out["degraded"] is True
+    assert "timeout" in out["failure"]
+    assert out["metric"] == "imgs_per_sec_per_chip"
+    assert out["value"] == bench._LAST_VERIFIED["value"]
+    assert out["sustained_imgs_per_sec"] == bench._LAST_VERIFIED["sustained"]
+    assert "value_source" in out
+    json.dumps(out)  # the degraded line must itself be valid JSON content
+
+
+def test_non_transient_error_bails_immediately(monkeypatch):
+    """A real bug (ImportError etc.) must not burn the retry window: one
+    attempt, then the degraded line with the failure recorded."""
+    monkeypatch.setenv("BENCH_RETRY_WINDOW_S", "3600")
+    out = bench.supervise(_child(
+        "import sys; sys.stderr.write('ImportError: no module nope\\n'); "
+        "sys.exit(1)"))
+    assert out["degraded"] is True
+    assert "ImportError" in out["failure"]
+    assert out["failure"].startswith("attempt 1 ")  # no retries happened
+
+
+def test_transient_retries_until_window_then_degraded(monkeypatch):
+    monkeypatch.setenv("BENCH_RETRY_WINDOW_S", "0")
+    out = bench.supervise(_child(
+        "import sys; sys.stderr.write('UNAVAILABLE: tunnel down\\n'); "
+        "sys.exit(1)"))
+    assert out["degraded"] is True
+    assert "UNAVAILABLE" in out["failure"]
+
+
+def test_signal_death_is_transient(tmp_path, monkeypatch):
+    """A child killed by a signal (OOM, runtime abort — rc<0) is
+    environment trouble, not a code bug: retry, don't bail."""
+    monkeypatch.setenv("BENCH_RETRY_WINDOW_S", "120")
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    marker = tmp_path / "tried"
+    script = (
+        "import json, os, signal, sys\n"
+        f"m = {str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').close()\n"
+        "    os.kill(os.getpid(), signal.SIGKILL)\n"
+        f"print(json.dumps({GOOD!r}))\n"
+    )
+    out = bench.supervise(_child(script))
+    assert out == GOOD
+
+
+def test_timed_out_child_with_result_is_salvaged(monkeypatch):
+    """A child that printed its complete JSON and then hung in teardown
+    (the tunnel's known pathology) still measured — its result must be
+    used, not thrown away."""
+    monkeypatch.setenv("BENCH_RETRY_WINDOW_S", "0")
+    monkeypatch.setenv("BENCH_ATTEMPT_TIMEOUT_S", "3")
+    script = (
+        "import json, sys, time\n"
+        f"print(json.dumps({GOOD!r}), flush=True)\n"
+        "time.sleep(600)\n"  # hang in 'teardown'
+    )
+    out = bench.supervise(_child(script))
+    assert out == GOOD
+    assert "degraded" not in out
+
+
+def test_parse_result_rejects_garbage():
+    assert bench._parse_result("") is None
+    assert bench._parse_result("not json\nstill not json") is None
+    assert bench._parse_result('["a", "list"]') is None
+    assert bench._parse_result('{"no_metric": 1}') is None
+    good = json.dumps(GOOD)
+    assert bench._parse_result(f"stderr-ish noise\n{good}\n") == GOOD
+
+
+def test_cli_emits_single_json_line_on_persistent_failure(tmp_path):
+    """End to end through ``python bench.py``: with an unusable child the
+    process must still exit 0 and print exactly one parseable JSON line on
+    stdout (the driver's contract)."""
+    env = {"BENCH_RETRY_WINDOW_S": "0", "BENCH_ATTEMPT_TIMEOUT_S": "2",
+           "PATH": "/usr/bin:/bin"}
+    # force run_once to hang instantly by pointing JAX at a bad coordinator?
+    # simpler: run the supervisor with a child that hangs, via a wrapper
+    code = (
+        "import json, sys\n"
+        "sys.path.insert(0, sys.argv[1])\n"
+        "import bench\n"
+        "print(json.dumps(bench.supervise("
+        "[sys.executable, '-c', 'import time; time.sleep(60)'])))\n"
+    )
+    repo = __file__.rsplit("/tests/", 1)[0]
+    r = subprocess.run([sys.executable, "-c", code, repo],
+                       capture_output=True, text=True, env=env, timeout=60)
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1
+    parsed = json.loads(lines[0])
+    assert parsed["degraded"] is True and "value" in parsed
