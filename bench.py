@@ -297,7 +297,8 @@ def _run_attempt(cmd, timeout: float):
     """Run one child, streaming its stderr through LIVE (an operator must
     be able to tell a hung backend from a slow warmup) while keeping a tail
     for failure classification.  Returns (rc, stdout, tail, timed_out);
-    rc is None when the child had to be killed at the timeout."""
+    ``timed_out`` is the authoritative kill indicator (after the kill the
+    child's rc reads -SIGKILL, a plain signal death)."""
     import collections
     import threading
 
@@ -364,8 +365,7 @@ def supervise(child_cmd=None) -> dict:
             # crashes carry no diagnosable message — treat as environment
             # trouble and keep retrying; only a recognizable non-transient
             # Python error (ImportError etc.) stops burning the window
-            transient = (rc is None or rc < 0 or not tail.strip()
-                         or _transient(tail))
+            transient = rc < 0 or not tail.strip() or _transient(tail)
         print(f"bench: {last_failure.splitlines()[0][:120]}",
               file=sys.stderr)
         if not transient:

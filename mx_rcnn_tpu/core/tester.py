@@ -53,44 +53,82 @@ class Predictor:
             self._batch_sharding = NamedSharding(mesh, P(data_axes(mesh)))
             self.variables = replicate(variables, mesh)
 
-    def raw(self, images: np.ndarray, im_info: np.ndarray):
-        """Forward pass returning DEVICE arrays (no host sync) — the eval
-        loop feeds these straight into the jitted postprocess.  Outputs
-        cover exactly the input rows (mesh padding is stripped)."""
-        n = images.shape[0]
+    def _forward(self, kind: str, arrays, pad_fills, make_fn):
+        """Shared forward scaffolding for every predictor mode: mesh
+        padding (``pad_fills`` per array — im_info pads with 1 so padded
+        rows never divide by zero), the per-(mode, shape, dtype) jit cache,
+        sharded placement, and pad-row trimming.  ``make_fn()`` builds the
+        jitted function on first use for a new shape."""
+        n = arrays[0].shape[0]
         if self.mesh is not None:
             pad = (-n) % self.mesh.size
             if pad:
-                images = np.concatenate(
-                    [images, np.zeros((pad,) + images.shape[1:],
-                                      images.dtype)])
-                im_info = np.concatenate(
-                    [im_info, np.ones((pad, 3), im_info.dtype)])
-        # keyed by shape AND dtype: uint8 raw batches and fp32
+                arrays = tuple(
+                    np.concatenate([a, np.full((pad,) + a.shape[1:], fill,
+                                               a.dtype)])
+                    for a, fill in zip(arrays, pad_fills))
+        # keyed by mode AND shape AND dtype: uint8 raw batches and fp32
         # host-normalized batches compile to different programs
-        shape = (tuple(images.shape), np.dtype(images.dtype).name)
+        shape = (kind,) + tuple(
+            (tuple(a.shape), np.dtype(a.dtype).name) for a in arrays)
         if shape not in self._fns:
-            model = self.model
-
-            @jax.jit
-            def fn(variables, images, im_info):
-                return model.apply(variables, images, im_info)
-
-            self._fns[shape] = fn
+            self._fns[shape] = make_fn()
         if self.mesh is not None:
             # device_put the host arrays straight into their shards — going
             # through jnp.asarray first would commit the whole batch to
             # device 0 and transfer it twice
-            images = jax.device_put(np.asarray(images), self._batch_sharding)
-            im_info = jax.device_put(np.asarray(im_info),
-                                     self._batch_sharding)
+            arrays = tuple(jax.device_put(np.asarray(a),
+                                          self._batch_sharding)
+                           for a in arrays)
         else:
-            images = jnp.asarray(images)
-            im_info = jnp.asarray(im_info)
-        out = self._fns[shape](self.variables, images, im_info)
+            arrays = tuple(jnp.asarray(a) for a in arrays)
+        out = self._fns[shape](self.variables, *arrays)
         if self.mesh is not None and out[0].shape[0] != n:
             out = tuple(o[:n] for o in out)
         return out
+
+    def raw(self, images: np.ndarray, im_info: np.ndarray):
+        """Forward pass returning DEVICE arrays (no host sync) — the eval
+        loop feeds these straight into the jitted postprocess.  Outputs
+        cover exactly the input rows (mesh padding is stripped)."""
+        model = self.model
+
+        def make_fn():
+            @jax.jit
+            def fn(variables, images, im_info):
+                return model.apply(variables, images, im_info)
+
+            return fn
+
+        return self._forward("rpn", (images, im_info), (0, 1), make_fn)
+
+    def raw_rois(self, images: np.ndarray, im_info: np.ndarray,
+                 rois: np.ndarray, rois_valid: np.ndarray):
+        """RCNN-only forward on precomputed proposals (ref the
+        HAS_RPN=False predictor used by ``rcnn/tools/test_rcnn.py``):
+        same contract as :meth:`raw` but the ROIs come from the loader, so
+        the model's ``detect_rois`` path runs instead of the RPN."""
+        model = self.model
+
+        def make_fn():
+            @jax.jit
+            def fn(variables, images, im_info, rois, rois_valid):
+                return model.apply(variables, images, im_info, rois,
+                                   rois_valid, method=model.detect_rois)
+
+            return fn
+
+        return self._forward("rois", (images, im_info, rois, rois_valid),
+                             (0, 1, 0, 0), make_fn)
+
+    def raw_batch(self, batch):
+        """Dispatch a loader batch: an RCNNBatch (carries ``rois`` from
+        precomputed proposals) runs the RCNN-only path; a plain Batch runs
+        the full RPN+RCNN test forward."""
+        if hasattr(batch, "rois"):
+            return self.raw_rois(batch.images, batch.im_info, batch.rois,
+                                 batch.rois_valid)
+        return self.raw(batch.images, batch.im_info)
 
     def __call__(self, images: np.ndarray, im_info: np.ndarray):
         rois, roi_valid, cls_prob, deltas = self.raw(images, im_info)
@@ -207,9 +245,14 @@ def pred_eval(predictor: Predictor, test_loader, imdb, cfg: Config,
                      num_classes)
     done = 0
     for batch, indices, scales in test_loader:
-        # device arrays stay on device between forward and postprocess
-        rois, roi_valid, cls_prob, deltas = predictor.raw(batch.images,
-                                                          batch.im_info)
+        # device arrays stay on device between forward and postprocess;
+        # raw_batch dispatches RPN-generated vs precomputed-ROI batches
+        # (duck-typed so fabricated test predictors exposing only .raw work)
+        if hasattr(predictor, "raw_batch"):
+            rois, roi_valid, cls_prob, deltas = predictor.raw_batch(batch)
+        else:
+            rois, roi_valid, cls_prob, deltas = predictor.raw(batch.images,
+                                                              batch.im_info)
         boxes_b, scores_b, keep_b = map(np.asarray, _postprocess_batch(
             rois, roi_valid, cls_prob, deltas, jnp.asarray(batch.im_info),
             jnp.asarray(scales), stds, means,

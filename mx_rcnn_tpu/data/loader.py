@@ -123,6 +123,33 @@ def _prefetched(work: Iterable, make: Callable, num_workers: int,
         ex.shutdown(wait=False, cancel_futures=True)
 
 
+def _check_proposals(proposals, roidb) -> list:
+    """Shared by the two proposal-fed loaders: one proposal set per roidb
+    record, in order."""
+    if len(proposals) != len(roidb):
+        raise ValueError(
+            f"{len(proposals)} proposal sets for {len(roidb)} roidb records")
+    return list(proposals)
+
+
+def _fill_rois(proposals, indices, scales, max_rois: int
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Pack raw-coordinate (k, 5) proposal arrays into the padded
+    (n, max_rois, 4) input-coordinate ROI buffer + validity mask.  ONE
+    implementation for the training side (:class:`ROIIter`) and the eval
+    side (:class:`ROITestLoader`) so the two stages can never disagree on
+    ROI semantics."""
+    n = len(indices)
+    rois = np.zeros((n, max_rois, 4), np.float32)
+    rois_valid = np.zeros((n, max_rois), bool)
+    for j, i in enumerate(indices):
+        p = np.asarray(proposals[i], np.float32).reshape(-1, 5)
+        k = min(len(p), max_rois)
+        rois[j, :k] = p[:k, :4] * scales[j]
+        rois_valid[j, :k] = True
+    return rois, rois_valid
+
+
 def _bucket_of(rec, buckets, scale, max_size) -> Tuple[int, int]:
     """Bucket for a roidb record after reference resizing."""
     h, w = rec["height"], rec["width"]
@@ -254,27 +281,15 @@ class ROIIter(AnchorLoader):
         super().__init__(roidb, cfg, batch_images, shuffle, seed,
                          num_workers=num_workers, prefetch=prefetch,
                          raw_images=raw_images, cache=cache)
-        if len(proposals) != len(self.roidb):
-            raise ValueError(
-                f"{len(proposals)} proposal sets for {len(self.roidb)} "
-                f"roidb records")
-        self.proposals = list(proposals)
+        self.proposals = _check_proposals(proposals, self.roidb)
         self.max_rois = max_rois or cfg.test.proposal_post_nms_top_n
 
     def _make_batch(self, indices: Sequence[int], bucket):
         from mx_rcnn_tpu.core.train import RCNNBatch
 
         base = super()._make_batch(indices, bucket)
-        n = len(indices)
-        r = self.max_rois
-        rois = np.zeros((n, r, 4), np.float32)
-        rois_valid = np.zeros((n, r), bool)
-        for j, i in enumerate(indices):
-            p = np.asarray(self.proposals[i], np.float32).reshape(-1, 5)
-            k = min(len(p), r)
-            scale = base.im_info[j, 2]
-            rois[j, :k] = p[:k, :4] * scale
-            rois_valid[j, :k] = True
+        rois, rois_valid = _fill_rois(self.proposals, indices,
+                                      base.im_info[:, 2], self.max_rois)
         return RCNNBatch(*base, rois=rois, rois_valid=rois_valid)
 
 
@@ -344,3 +359,37 @@ class TestLoader(_ImageSource):
         yield from _prefetched(
             batches, lambda b: self._make_batch(b[1], b[0]),
             self.num_workers, self.prefetch)
+
+
+class ROITestLoader(TestLoader):
+    """Evaluation loader for RCNN-only checkpoints: like :class:`TestLoader`
+    but each batch also carries precomputed proposals (ref the
+    HAS_RPN=False ``TestLoader`` feeding ``rcnn/tools/test_rcnn.py``).
+
+    ``proposals[i]`` is the (k, 5) [x1 y1 x2 y2 score] array for roidb
+    record ``i`` in RAW image coordinates (the pkl written by
+    ``tools/test_rpn.py``); boxes are scaled into input coordinates and
+    padded to ``max_rois`` slots, mirroring :class:`ROIIter` on the
+    training side so the two stages see identical ROI semantics.
+    """
+
+    def __init__(self, roidb: Roidb, cfg: Config, proposals: Sequence,
+                 batch_images: int = None, max_rois: int = None,
+                 num_workers: int = None, prefetch: int = None,
+                 raw_images: bool = None, cache: DecodedImageCache = None):
+        super().__init__(roidb, cfg, batch_images, num_workers=num_workers,
+                         prefetch=prefetch, raw_images=raw_images,
+                         cache=cache)
+        self.proposals = _check_proposals(proposals, self.roidb)
+        # same default slot count as the training-side ROIIter: proposal
+        # dumps are post-NMS-capped at proposal_post_nms_top_n
+        self.max_rois = max_rois or cfg.test.proposal_post_nms_top_n
+
+    def _make_batch(self, chunk: Sequence[int], bucket):
+        from mx_rcnn_tpu.core.train import RCNNBatch
+
+        base, indices, scales = super()._make_batch(chunk, bucket)
+        rois, rois_valid = _fill_rois(self.proposals, indices, scales,
+                                      self.max_rois)
+        return (RCNNBatch(*base, rois=rois, rois_valid=rois_valid),
+                indices, scales)

@@ -140,6 +140,40 @@ class FasterRCNN(nn.Module):
 
         return jax.vmap(one)(fg, rpn_box.astype(jnp.float32), im_info)
 
+    def detect_rois(self, images: jnp.ndarray, im_info: jnp.ndarray,
+                    rois: jnp.ndarray, roi_valid: jnp.ndarray
+                    ) -> Tuple[jnp.ndarray, ...]:
+        """RCNN-only test forward on PRECOMPUTED proposals (ref the
+        HAS_RPN=False test symbol consumed by ``rcnn/tools/test_rcnn.py``):
+        skips the RPN entirely and classifies the given ROIs.
+
+        Args:
+          images: (N, H, W, 3) as in ``__call__``.
+          im_info: (N, 3).
+          rois: (N, R, 4) proposal boxes in INPUT (scaled) coordinates.
+          roi_valid: (N, R) bool mask for padded proposal slots.
+        Returns the same tuple as ``__call__`` so the eval postprocess is
+        shared: (rois, roi_valid, cls_prob, bbox_deltas).
+        """
+        feat = self.features(images, im_info)
+        n = feat.shape[0]
+
+        def pool_one(feat_i, rois_i):
+            return roi_align(feat_i, rois_i, self.pooled_size,
+                             1.0 / self.feat_stride)
+
+        pooled = jax.vmap(pool_one)(feat, rois)  # (N, R, ph, pw, C)
+        r = pooled.shape[1]
+        flat = pooled.reshape((n * r,) + pooled.shape[2:])
+        cls_logits, deltas = self.roi_head(flat, train=False)
+        cls_prob = jax.nn.softmax(cls_logits.astype(jnp.float32), axis=-1)
+        return (
+            rois,
+            roi_valid,
+            cls_prob.reshape(n, r, self.num_classes),
+            deltas.astype(jnp.float32).reshape(n, r, 4 * self.num_classes),
+        )
+
     # ---- full test-mode forward (ref get_*_test symbol) -------------------
 
     def __call__(self, images: jnp.ndarray, im_info: jnp.ndarray

@@ -1,0 +1,117 @@
+"""RCNN-stage evaluation: an RCNN-only checkpoint + precomputed proposals
+→ mAP.
+
+Reference: ``rcnn/tools/test_rcnn.py`` (SURVEY.md §2.2) — the eval side of
+alternate-training stages 2/4: instead of running the RPN, the detector
+head classifies proposals dumped by ``tools/test_rpn.py`` (use its
+``--eval_set`` flag to dump over the TEST roidb).  The combined end2end
+checkpoint evaluates through ``tools/test.py``; this tool makes the
+intermediate stage checkpoints independently measurable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import pickle
+from typing import Dict
+
+from mx_rcnn_tpu.config import Config, generate_config
+from mx_rcnn_tpu.core.tester import Predictor, pred_eval
+from mx_rcnn_tpu.data import ROITestLoader, load_gt_roidb
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.tools.train import add_set_arg, parse_set_overrides
+from mx_rcnn_tpu.utils.checkpoint import load_param
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+
+def test_rcnn_stage(cfg: Config, *, prefix: str, epoch: int, proposals,
+                    image_set: str = None, out_dir: str = None,
+                    verbose: bool = True, dataset_kw: dict = None,
+                    save_dets: str = None, num_devices: int = 1
+                    ) -> Dict[str, float]:
+    """Evaluate RCNN-only checkpoint ``prefix``@``epoch`` on
+    ``proposals`` (list of (k, 5) arrays in TEST-roidb order, raw image
+    coordinates); returns the metric dict (includes ``mAP`` for VOC-style
+    evaluators)."""
+    imdb, roidb = load_gt_roidb(cfg, image_set=image_set, training=False,
+                                **(dataset_kw or {}))
+    mesh = None
+    if num_devices > 1:
+        import jax
+
+        from mx_rcnn_tpu.parallel.dp import device_mesh
+
+        available = len(jax.devices())
+        if num_devices > available:
+            raise ValueError(
+                f"--num_devices {num_devices} but only {available} "
+                f"device(s) available")
+        mesh = device_mesh(num_devices)
+    loader = ROITestLoader(roidb, cfg, proposals,
+                           batch_images=cfg.test.batch_images * num_devices)
+    model = build_model(cfg)
+    params, batch_stats = load_param(prefix, epoch)
+    predictor = Predictor(
+        model, {"params": params, "batch_stats": batch_stats}, cfg,
+        mesh=mesh)
+    results = pred_eval(predictor, loader, imdb, cfg, out_dir=out_dir,
+                        verbose=verbose, save_dets=save_dets)
+    for k, v in sorted(results.items()):
+        logger.info("%s AP = %.4f", k, v)
+    if "mAP" in results:
+        print(f"mAP = {results['mAP']:.4f}")
+    return results
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        description="Evaluate an RCNN-only stage checkpoint on precomputed "
+                    "proposals (ref rcnn/tools/test_rcnn.py)")
+    p.add_argument("--network", default="resnet101",
+                   choices=["vgg", "resnet50", "resnet101", "tiny"])
+    p.add_argument("--dataset", default="PascalVOC",
+                   choices=["PascalVOC", "coco", "synthetic"])
+    p.add_argument("--image_set", default=None,
+                   help="defaults to the dataset's test_image_set")
+    p.add_argument("--root_path", default=None)
+    p.add_argument("--dataset_path", default=None)
+    p.add_argument("--prefix", default="model/rcnn")
+    p.add_argument("--epoch", type=int, required=True)
+    p.add_argument("--proposals", required=True,
+                   help="proposal pkl over the TEST roidb "
+                        "(tools/test_rpn.py --eval_set)")
+    p.add_argument("--out_dir", default=None,
+                   help="write detection files here (VOC comp4 / COCO json)")
+    p.add_argument("--save_dets", default=None,
+                   help="pickle raw detections here for tools/reeval.py")
+    p.add_argument("--num_devices", type=int, default=1,
+                   help="shard eval batches over this many devices")
+    add_set_arg(p)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    args = parse_args(argv)
+    overrides = {}
+    if args.root_path:
+        overrides["dataset__root_path"] = args.root_path
+    if args.dataset_path:
+        overrides["dataset__dataset_path"] = args.dataset_path
+    overrides.update(parse_set_overrides(args))
+    cfg = generate_config(args.network, args.dataset, **overrides)
+    with open(args.proposals, "rb") as f:
+        proposals = pickle.load(f)
+    logger.info("loaded proposals for %d images from %s", len(proposals),
+                args.proposals)
+    test_rcnn_stage(cfg, prefix=args.prefix, epoch=args.epoch,
+                    proposals=proposals, image_set=args.image_set,
+                    out_dir=args.out_dir, save_dets=args.save_dets,
+                    num_devices=args.num_devices)
+
+
+if __name__ == "__main__":
+    main()

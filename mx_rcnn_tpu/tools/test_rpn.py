@@ -34,13 +34,19 @@ def main(argv=None):
     p.add_argument("--epoch", type=int, required=True)
     p.add_argument("--out", required=True, help="output proposal pkl path")
     p.add_argument("--no_flip", action="store_true")
+    p.add_argument("--eval_set", action="store_true",
+                   help="dump over the TEST roidb (no flip/filter) for "
+                        "tools/test_rcnn.py instead of the train roidb")
     add_set_arg(p)
     args = p.parse_args(argv)
     cfg = stage_config(args)
-    # proposals are generated over the TRAIN roidb (flip-augmented unless
+    # default: proposals over the TRAIN roidb (flip-augmented unless
     # --no_flip), mirroring the alternate-training stage 1.5/3.5 dumps —
-    # shared implementation so the pkl format cannot diverge
-    _, roidb = load_gt_roidb(cfg, training=True)
+    # shared implementation so the pkl format cannot diverge.  --eval_set
+    # dumps over the TEST roidb for RCNN-stage evaluation (ref generates
+    # its rpn_data test pkl the same way).
+    _, roidb = load_gt_roidb(cfg, image_set=args.image_set,
+                             training=not args.eval_set)
     _dump_proposals(cfg, roidb, args.prefix, args.epoch, args.out)
 
 

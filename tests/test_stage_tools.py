@@ -11,7 +11,7 @@ import pickle
 
 import numpy as np
 
-from mx_rcnn_tpu.tools import test_rpn, train_rcnn, train_rpn
+from mx_rcnn_tpu.tools import test_rcnn, test_rpn, train_rcnn, train_rpn
 
 
 def test_stage_clis_chain(tmp_path):
@@ -38,3 +38,15 @@ def test_stage_clis_chain(tmp_path):
         "--init_from", rpn_prefix, "--init_from_epoch", "1",
         "--frozen_shared"])
     assert os.path.exists(rcnn_prefix + "-0001.ckpt")
+
+    # eval side of the stage (ref rcnn/tools/test_rcnn.py): dump proposals
+    # over the TEST roidb, then evaluate the RCNN-only checkpoint on them
+    eval_props = str(tmp_path / "props_test.pkl")
+    test_rpn.main(common + ["--prefix", rpn_prefix, "--epoch", "1",
+                            "--out", eval_props, "--eval_set"])
+    with open(eval_props, "rb") as f:
+        test_proposals = pickle.load(f)
+    assert len(test_proposals) == 16  # synthetic TEST set (no flip/filter)
+    test_rcnn.main(["--network", "tiny", "--dataset", "synthetic",
+                    "--root_path", root, "--prefix", rcnn_prefix,
+                    "--epoch", "1", "--proposals", eval_props])

@@ -198,6 +198,84 @@ def test_pred_eval_max_per_image_cap(tmp_path):
         assert results["mAP"] == pytest.approx(1.0)
 
 
+def test_detect_rois_matches_full_forward():
+    """The RCNN-only path (``detect_rois``, ref test_rcnn.py's
+    HAS_RPN=False symbol) fed the model's OWN RPN proposals must reproduce
+    ``__call__``'s cls_prob/deltas exactly — same features, same pooling,
+    same head, just without re-running the proposal machinery."""
+    cfg = _toy_cfg()
+    model = build_model(cfg)
+    rng = np.random.RandomState(3)
+    images = rng.uniform(0, 50, (2, 128, 160, 3)).astype(np.float32)
+    im_info = np.array([[128.0, 160.0, 1.0]] * 2, np.float32)
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                    jnp.asarray(images),
+                                    jnp.asarray(im_info))
+    rois, valid, prob, deltas = model.apply(
+        variables, jnp.asarray(images), jnp.asarray(im_info))
+    rois2, valid2, prob2, deltas2 = model.apply(
+        variables, jnp.asarray(images), jnp.asarray(im_info), rois, valid,
+        method=model.detect_rois)
+    np.testing.assert_array_equal(np.asarray(rois), np.asarray(rois2))
+    np.testing.assert_array_equal(np.asarray(valid), np.asarray(valid2))
+    np.testing.assert_allclose(np.asarray(prob), np.asarray(prob2),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(deltas), np.asarray(deltas2),
+                               atol=1e-6)
+
+
+def test_predictor_raw_batch_dispatch(tmp_path):
+    """Predictor.raw_batch routes RCNNBatch → detect_rois and Batch → the
+    full forward; the ROITestLoader feeds the former end to end through
+    pred_eval."""
+    from mx_rcnn_tpu.core.train import Batch, RCNNBatch
+    from mx_rcnn_tpu.data import ROITestLoader
+
+    cfg = _toy_cfg(num_classes=4)
+    cfg = cfg.replace_in(
+        "dataset", root_path=str(tmp_path),
+        dataset_path=str(tmp_path / "synthetic"))
+    cfg = cfg.replace_in("test", proposal_post_nms_top_n=16)
+    kw = dict(num_images=4, image_size=(128, 160), max_objects=3)
+    imdb, roidb = load_gt_roidb(cfg, training=False, **kw)
+    model = build_model(cfg)
+    images = np.zeros((1, 128, 160, 3), np.float32)
+    im_info = np.array([[128.0, 160.0, 1.0]], np.float32)
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                    jnp.asarray(images),
+                                    jnp.asarray(im_info))
+    pred = Predictor(model, variables, cfg)
+
+    # gt boxes as proposals: an untrained head scores garbage, but every
+    # shape/ordering contract is exercised
+    proposals = [
+        np.hstack([rec["boxes"],
+                   np.linspace(1, .5, len(rec["boxes"]))[:, None]]
+                  ).astype(np.float32)
+        for rec in roidb
+    ]
+    loader = ROITestLoader(roidb, cfg, proposals, batch_images=2)
+    batch, indices, scales = next(iter(loader))
+    assert isinstance(batch, RCNNBatch)
+    assert batch.rois.shape == (2, 16, 4)
+    out = pred.raw_batch(batch)
+    r = cfg.test.proposal_post_nms_top_n
+    assert np.asarray(out[2]).shape == (2, r, cfg.num_classes)
+    # given rois pass through unchanged
+    np.testing.assert_array_equal(np.asarray(out[0]), batch.rois)
+    # plain Batch routes to the RPN path (R = rpn_post_nms_top_n)
+    plain = Batch(batch.images, batch.im_info, batch.gt_boxes,
+                  batch.gt_classes, batch.gt_valid)
+    out_rpn = pred.raw_batch(plain)
+    assert np.asarray(out_rpn[0]).shape == (2, cfg.test.rpn_post_nms_top_n, 4)
+    # end to end: pred_eval over the ROI loader produces a finite mAP
+    results = pred_eval(pred, loader, imdb, cfg, verbose=False)
+    assert np.isfinite(results["mAP"])
+    # mismatched proposal list length is rejected
+    with pytest.raises(ValueError):
+        ROITestLoader(roidb, cfg, proposals[:-1])
+
+
 def test_generate_proposals_structure(tmp_path):
     cfg = _toy_cfg(num_classes=4)
     cfg = cfg.replace_in(
