@@ -273,14 +273,25 @@ _DATASETS: Mapping[str, Mapping[str, Any]] = {
         dataset_path="data/synthetic",
         num_classes=4,
     ),
+    # the accuracy gauntlet (data/synthetic.py — HardSyntheticDataset):
+    # 8 fg classes, 200/100 images, scale/occlusion/crowding + distractors
+    "synthetic_hard": dict(
+        name="synthetic_hard",
+        image_set="train",
+        test_image_set="test",
+        dataset_path="data/synthetic_hard",
+        num_classes=9,
+    ),
 }
 
 # Per-dataset bucket presets (TPU addition): synthetic canvases are
-# 320x400, so resizing them to the VOC 600/1000 scale would only waste
-# compute on interpolated pixels.
+# 320x400 (hard: 240x320), so resizing them to the VOC 600/1000 scale
+# would only waste compute on interpolated pixels.
 _DATASET_BUCKETS: Mapping[str, Mapping[str, Any]] = {
     "synthetic": dict(scale=320, max_size=416,
                       shapes=((320, 416), (416, 320))),
+    "synthetic_hard": dict(scale=240, max_size=320,
+                           shapes=((240, 320), (320, 240))),
 }
 
 

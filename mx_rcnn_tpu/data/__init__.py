@@ -21,7 +21,8 @@ from mx_rcnn_tpu.data.loader import (AnchorLoader, ROITestLoader,  # noqa: F401
 from mx_rcnn_tpu.data.roidb import IMDB, filter_roidb, merge_roidbs  # noqa: F401
 from mx_rcnn_tpu.data.pascal_voc import PascalVOC  # noqa: F401
 from mx_rcnn_tpu.data.coco import COCODataset  # noqa: F401
-from mx_rcnn_tpu.data.synthetic import SyntheticDataset  # noqa: F401
+from mx_rcnn_tpu.data.synthetic import (HardSyntheticDataset,  # noqa: F401
+                                        SyntheticDataset)
 
 
 def get_dataset(name: str, image_set: str, root_path: str, dataset_path: str,
@@ -32,6 +33,7 @@ def get_dataset(name: str, image_set: str, root_path: str, dataset_path: str,
         "PascalVOC": PascalVOC,
         "coco": COCODataset,
         "synthetic": SyntheticDataset,
+        "synthetic_hard": HardSyntheticDataset,
     }
     if name not in table:
         raise KeyError(f"unknown dataset {name!r}; have {sorted(table)}")
@@ -58,7 +60,7 @@ def load_gt_roidb(cfg, image_set: str = None, training: bool = True,
         # silently drop the later sets from the reported mAP
         raise ValueError(
             f"'+'-joined image sets are train-only; got {image_set!r}")
-    if ds.name == "synthetic":
+    if ds.name in ("synthetic", "synthetic_hard"):
         kw.setdefault("num_classes", ds.num_classes)
     imdbs, roidbs = [], []
     for sset in image_set.split("+"):

@@ -1,0 +1,103 @@
+"""Process-parallel image decoding for the host input pipeline.
+
+Reference: none — the reference loader is synchronous (SURVEY.md §3.1
+footnote), which is fine when one GPU consumes ~4 imgs/s but not when 8
+TPU chips consume ~580.  The thread prefetcher (``loader.py —
+_prefetched``) overlaps batch ASSEMBLY with device steps, but Python-side
+work (PNG/JPEG entropy decode beyond the GIL-released cv2 kernels, numpy
+copies, cache bookkeeping) still serializes on one interpreter; a process
+pool removes that ceiling.
+
+Design:
+* **spawn** context — fork is unsafe once JAX/XLA threads exist, and the
+  loaders run in processes that have usually initialized a backend,
+* workers are tiny: they import only the decode path (numpy/cv2), never
+  JAX; each holds its own :class:`DecodedImageCache` whose RAM tier is
+  per-process but whose DISK tier is shared — the cache's atomic
+  tmp+rename writes are already multi-process safe,
+* the parent never ships pixels TO workers, only paths; pixels come back
+  once per decode (~2 MB/image IPC, amortized against ~11 ms of decode),
+* ``im_scale`` is NOT returned by workers: it is a pure function of record
+  geometry (``cache.plan_scale``), pinned equal to the decode path's scale
+  by test, so the parent derives it locally and the wire format stays a
+  single uint8 array.
+
+Honest scaling note (docs/PERF.md): this box has 1 CPU core, so local
+measurements can show overhead, not speedup; ``tools/loader_bench.py``
+reports per-worker efficiency and states the extrapolation assumption
+explicitly instead of extrapolating silently.
+
+Standard multiprocessing caveat: construct the pool only from code
+reachable under ``if __name__ == "__main__":`` (or from an importable
+module) — the spawn context re-imports ``__main__`` in each worker, which
+fails for stdin/interactive scripts.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+from concurrent.futures import ProcessPoolExecutor
+from typing import Optional, Tuple
+
+import numpy as np
+
+# per-worker singleton cache (initialized once per process, not per task)
+_WORKER_CACHE = None
+
+
+def _init_worker(cache_dir: Optional[str], ram_bytes: int) -> None:
+    global _WORKER_CACHE
+    if cache_dir or ram_bytes > 0:
+        from mx_rcnn_tpu.data.cache import DecodedImageCache
+
+        _WORKER_CACHE = DecodedImageCache(ram_bytes=ram_bytes,
+                                          cache_dir=cache_dir)
+    else:
+        _WORKER_CACHE = None
+
+
+def _decode(path: str, flipped: bool, scale: int, max_size: int,
+            bucket: Tuple[int, int]) -> np.ndarray:
+    """Worker task: decode→flip→resize, returning the unpadded uint8
+    pixels (the parent derives im_scale via ``plan_scale``)."""
+    if _WORKER_CACHE is not None:
+        return _WORKER_CACHE.load(path, flipped, scale, max_size, bucket)
+    from mx_rcnn_tpu.data.image import load_resized_uint8
+
+    img, _ = load_resized_uint8(path, flipped, scale, max_size, bucket)
+    return img
+
+
+class DecodePool:
+    """A spawn-context process pool decoding images for the loaders.
+
+    Args:
+      num_procs: worker process count (>= 1).
+      cache_dir: optional shared disk cache directory (multi-process safe).
+      ram_bytes: per-WORKER RAM cache budget (0 disables; note the total
+        RSS across workers is ``num_procs * ram_bytes``).
+    """
+
+    def __init__(self, num_procs: int, cache_dir: Optional[str] = None,
+                 ram_bytes: int = 0):
+        if num_procs < 1:
+            raise ValueError("num_procs must be >= 1")
+        self.num_procs = num_procs
+        self._ex = ProcessPoolExecutor(
+            num_procs, mp_context=mp.get_context("spawn"),
+            initializer=_init_worker, initargs=(cache_dir, ram_bytes))
+
+    def submit(self, path: str, flipped: bool, scale: int, max_size: int,
+               bucket: Tuple[int, int]):
+        """Schedule one decode; returns a Future of the uint8 pixels."""
+        return self._ex.submit(_decode, path, flipped, scale, max_size,
+                               bucket)
+
+    def close(self) -> None:
+        self._ex.shutdown(wait=False, cancel_futures=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -52,11 +52,22 @@ class _ImageSource:
     device-side normalization mask depends on them being exact.
     """
 
-    def _init_source(self, cfg: Config, raw_images, cache) -> None:
+    def _init_source(self, cfg: Config, raw_images, cache,
+                     decode_pool=None) -> None:
         self.raw_images = (cfg.default.raw_images if raw_images is None
                            else raw_images)
         self.cache = cache
+        self.decode_pool = decode_pool
         self._pixel_means = np.asarray(cfg.network.pixel_means, np.float32)
+
+    def _write_slot(self, out: np.ndarray, img: np.ndarray) -> Tuple[int, int]:
+        h, w = img.shape[:2]
+        if self.raw_images:
+            out[:h, :w] = img
+        else:
+            np.subtract(img, self._pixel_means, out=out[:h, :w],
+                        casting="unsafe")
+        return h, w
 
     def _image_into(self, out: np.ndarray, rec, bucket) -> Tuple[int, int, float]:
         """Decode ``rec`` (through the cache if present) and write it into
@@ -72,13 +83,33 @@ class _ImageSource:
         else:
             img, im_scale = load_resized_uint8(rec["image"], flipped, scale,
                                                max_size, bucket)
-        h, w = img.shape[:2]
-        if self.raw_images:
-            out[:h, :w] = img
-        else:
-            np.subtract(img, self._pixel_means, out=out[:h, :w],
-                        casting="unsafe")
+        h, w = self._write_slot(out, img)
         return h, w, im_scale
+
+    def _images_into(self, images: np.ndarray, recs, bucket
+                     ) -> List[Tuple[int, int, float]]:
+        """Decode ``recs`` into the padded batch buffer; returns one
+        (h, w, im_scale) per record.  With a :class:`DecodePool`
+        (``data/decode_pool.py``) the decodes run in worker PROCESSES —
+        all images of the batch in flight at once — and im_scale is
+        derived parent-side from the record geometry (``plan_scale`` is
+        pinned equal to the decode path's scale); without one, the decode
+        runs in-thread through the optional cache."""
+        if self.decode_pool is None:
+            return [self._image_into(images[j], rec, bucket)
+                    for j, rec in enumerate(recs)]
+        cfg = self.cfg
+        scale, max_size = cfg.bucket.scale, cfg.bucket.max_size
+        futs = [self.decode_pool.submit(rec["image"],
+                                        rec.get("flipped", False),
+                                        scale, max_size, bucket)
+                for rec in recs]
+        infos = []
+        for j, (rec, fut) in enumerate(zip(recs, futs)):
+            h, w = self._write_slot(images[j], fut.result())
+            infos.append((h, w, plan_scale(rec["height"], rec["width"],
+                                           scale, max_size, bucket)))
+        return infos
 
     def _image_buffer(self, n: int, bucket) -> np.ndarray:
         dtype = np.uint8 if self.raw_images else np.float32
@@ -168,10 +199,11 @@ class AnchorLoader(_ImageSource):
     def __init__(self, roidb: Roidb, cfg: Config, batch_images: int = None,
                  shuffle: bool = True, seed: int = 0,
                  num_workers: int = None, prefetch: int = None,
-                 raw_images: bool = None, cache: DecodedImageCache = None):
+                 raw_images: bool = None, cache: DecodedImageCache = None,
+                 decode_pool=None):
         self.roidb = list(roidb)
         self.cfg = cfg
-        self._init_source(cfg, raw_images, cache)
+        self._init_source(cfg, raw_images, cache, decode_pool)
         self.batch_images = batch_images or cfg.train.batch_images
         self.shuffle = shuffle
         self.seed = seed
@@ -206,9 +238,10 @@ class AnchorLoader(_ImageSource):
         gt_boxes = np.zeros((n, g, 4), np.float32)
         gt_classes = np.zeros((n, g), np.int32)
         gt_valid = np.zeros((n, g), bool)
-        for j, i in enumerate(indices):
-            rec = self.roidb[i]
-            h, w, im_scale = self._image_into(images[j], rec, bucket)
+        recs = [self.roidb[i] for i in indices]
+        infos = self._images_into(images, recs, bucket)
+        for j, rec in enumerate(recs):
+            h, w, im_scale = infos[j]
             im_info[j] = (h, w, im_scale)
             k = min(len(rec["boxes"]), g)
             if k:
@@ -277,10 +310,12 @@ class ROIIter(AnchorLoader):
                  batch_images: int = None, shuffle: bool = True,
                  seed: int = 0, max_rois: int = None,
                  num_workers: int = None, prefetch: int = None,
-                 raw_images: bool = None, cache: DecodedImageCache = None):
+                 raw_images: bool = None, cache: DecodedImageCache = None,
+                 decode_pool=None):
         super().__init__(roidb, cfg, batch_images, shuffle, seed,
                          num_workers=num_workers, prefetch=prefetch,
-                         raw_images=raw_images, cache=cache)
+                         raw_images=raw_images, cache=cache,
+                         decode_pool=decode_pool)
         self.proposals = _check_proposals(proposals, self.roidb)
         self.max_rois = max_rois or cfg.test.proposal_post_nms_top_n
 
@@ -301,10 +336,11 @@ class TestLoader(_ImageSource):
 
     def __init__(self, roidb: Roidb, cfg: Config, batch_images: int = None,
                  num_workers: int = None, prefetch: int = None,
-                 raw_images: bool = None, cache: DecodedImageCache = None):
+                 raw_images: bool = None, cache: DecodedImageCache = None,
+                 decode_pool=None):
         self.roidb = list(roidb)
         self.cfg = cfg
-        self._init_source(cfg, raw_images, cache)
+        self._init_source(cfg, raw_images, cache, decode_pool)
         self.batch_images = batch_images or cfg.test.batch_images
         self.num_workers = (cfg.default.num_workers if num_workers is None
                             else num_workers)
@@ -333,12 +369,12 @@ class TestLoader(_ImageSource):
         images = self._image_buffer(n, bucket)
         im_info = np.zeros((n, 3), np.float32)
         scales = np.zeros((n,), np.float32)
-        for j, i in enumerate(chunk):
-            rec = self.roidb[i]
-            # the flipped flag is honored here: eval roidbs never set it,
-            # but alternate training generates proposals over the
-            # flip-augmented TRAIN roidb through this loader
-            h, w, im_scale = self._image_into(images[j], rec, bucket)
+        # the flipped flag is honored here: eval roidbs never set it, but
+        # alternate training generates proposals over the flip-augmented
+        # TRAIN roidb through this loader
+        recs = [self.roidb[i] for i in chunk]
+        infos = self._images_into(images, recs, bucket)
+        for j, (h, w, im_scale) in enumerate(infos):
             im_info[j] = (h, w, im_scale)
             scales[j] = im_scale
         g = cfg.train.max_gt_boxes
@@ -376,10 +412,11 @@ class ROITestLoader(TestLoader):
     def __init__(self, roidb: Roidb, cfg: Config, proposals: Sequence,
                  batch_images: int = None, max_rois: int = None,
                  num_workers: int = None, prefetch: int = None,
-                 raw_images: bool = None, cache: DecodedImageCache = None):
+                 raw_images: bool = None, cache: DecodedImageCache = None,
+                 decode_pool=None):
         super().__init__(roidb, cfg, batch_images, num_workers=num_workers,
                          prefetch=prefetch, raw_images=raw_images,
-                         cache=cache)
+                         cache=cache, decode_pool=decode_pool)
         self.proposals = _check_proposals(proposals, self.roidb)
         # same default slot count as the training-side ROIIter: proposal
         # dumps are post-NMS-capped at proposal_post_nms_top_n

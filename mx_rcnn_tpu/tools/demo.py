@@ -115,7 +115,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--network", default="resnet101",
                    choices=["vgg", "resnet50", "resnet101", "tiny"])
     p.add_argument("--dataset", default="PascalVOC",
-                   choices=["PascalVOC", "coco", "synthetic"])
+                   choices=["PascalVOC", "coco", "synthetic", "synthetic_hard"])
     p.add_argument("--prefix", default="model/e2e")
     p.add_argument("--epoch", type=int, required=True)
     p.add_argument("--image", required=True)

@@ -9,13 +9,20 @@ milliseconds.
 
 Measurement methodology (important on tunneled devices): a host→device
 round-trip can cost ~100 ms, so single-call timing drowns in RTT.  Each
-stage is wrapped in a ``lax.fori_loop`` that runs it N times inside ONE
-XLA program with an unfoldable data dependency (carry · 1e-30 injected into
-the stage input, carry re-derived from the stage output), then timed with a
-single dispatch + fetch; per-iteration time = (wall − RTT) / N.
+stage is chained N times inside ONE XLA program with an unfoldable data
+dependency (carry · 1e-30 injected into the stage input, carry re-derived
+from the stage output), then timed with a single dispatch + fetch;
+per-iteration time = (wall − RTT) / N.
+
+The chain is UNROLLED at trace time, not a ``lax.fori_loop``: loop bodies
+at ResNet-101 size hit a compile pathology on this stack (the loop-wrapped
+program runs ~12× slower than the flat one — docs/PERF.md round 3, which
+killed the round-2 fori_loop methodology).  Unrolling sidesteps the loop
+op entirely at the cost of compile time linear in N — hence the default
+N of 8; raise ``--iters`` on fast-compiling devices for tighter numbers.
 
 Usage:
-  python -m mx_rcnn_tpu.tools.profile_step --network resnet101 --iters 20
+  python -m mx_rcnn_tpu.tools.profile_step --network resnet101 --iters 8
 """
 
 from __future__ import annotations
@@ -69,8 +76,9 @@ def main(argv=None) -> None:
     p.add_argument("--dataset", default="coco")
     p.add_argument("--batch_images", type=int, default=2)
     p.add_argument("--shape", default="608x1024")
-    p.add_argument("--iters", type=int, default=20,
-                   help="loop length inside the timed XLA program")
+    p.add_argument("--iters", type=int, default=8,
+                   help="unrolled chain length inside the timed XLA "
+                        "program (compile time grows with it)")
     p.add_argument("--trace_dir", default=None,
                    help="also dump a jax.profiler trace here")
     args = p.parse_args(argv)
@@ -130,9 +138,16 @@ def main(argv=None) -> None:
                 time.sleep(5.0)
 
     def timed_loop(stage, label, note=""):
-        """stage: carry (f32 scalar) -> carry.  Runs N reps in one program."""
-        looped = jax.jit(lambda c: jax.lax.fori_loop(
-            0, N, lambda i, cc: stage(cc), c))
+        """stage: carry (f32 scalar) -> carry.  Runs N reps in one program,
+        UNROLLED (no fori_loop — see module docstring); the carry chain is
+        an unfoldable data dependence, so XLA cannot CSE the copies."""
+
+        def chain(c):
+            for _ in range(N):
+                c = stage(c)
+            return c
+
+        looped = jax.jit(chain)
         retry_compile(lambda: fetch(looped(jnp.float32(0))))  # compile+warm
         t0 = time.perf_counter()
         fetch(looped(jnp.float32(0)))

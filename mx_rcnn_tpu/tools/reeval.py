@@ -56,7 +56,7 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--network", default="resnet101",
                    choices=["vgg", "resnet50", "resnet101", "tiny"])
     p.add_argument("--dataset", default="PascalVOC",
-                   choices=["PascalVOC", "coco", "synthetic"])
+                   choices=["PascalVOC", "coco", "synthetic", "synthetic_hard"])
     p.add_argument("--image_set", default=None)
     p.add_argument("--root_path", default=None)
     p.add_argument("--dataset_path", default=None)

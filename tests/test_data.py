@@ -353,6 +353,33 @@ def test_cache_invalidates_on_source_file_change(tmp_path):
     assert len(_glob.glob(str(tmp_path / "c" / "*.npy"))) == 1
 
 
+@pytest.mark.slow
+def test_decode_pool_identical_batches(tmp_path):
+    """A DecodePool-backed loader must yield batches identical to the
+    in-thread loader (pixels AND im_info), and the pool must be spawn-safe
+    (workers never import JAX).  Slow tier: spawning interpreters costs
+    seconds."""
+    from mx_rcnn_tpu.data.decode_pool import DecodePool
+    from mx_rcnn_tpu.data.roidb import IMDB
+
+    cfg = generate_config("tiny", "synthetic")
+    roidb = IMDB.append_flipped_images(_mini_roidb(tmp_path))
+    plain = AnchorLoader(roidb, cfg, batch_images=2, shuffle=True, seed=7,
+                         num_workers=0)
+    with DecodePool(2, cache_dir=str(tmp_path / "pc")) as pool:
+        pooled = AnchorLoader(roidb, cfg, batch_images=2, shuffle=True,
+                              seed=7, num_workers=2, decode_pool=pool)
+        for bp, bc in zip(plain, pooled):
+            np.testing.assert_array_equal(bp.images, bc.images)
+            np.testing.assert_array_equal(bp.im_info, bc.im_info)
+            np.testing.assert_array_equal(bp.gt_boxes, bc.gt_boxes)
+        # second epoch rides the shared disk cache written by the workers
+        for bp, bc in zip(plain, pooled):
+            np.testing.assert_array_equal(bp.images, bc.images)
+    with pytest.raises(ValueError):
+        DecodePool(0)
+
+
 def test_cached_loader_identical_batches(tmp_path):
     """A cache-backed loader must yield batches identical to the direct
     loader, epoch after epoch (including flip keys)."""

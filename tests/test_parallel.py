@@ -127,6 +127,73 @@ def test_dp_uneven_rng_decorrelated():
 
 
 @pytest.mark.slow
+def test_checkpoint_portable_across_mesh_sizes(tmp_path):
+    """Cross-mesh checkpoint portability (VERDICT r03 item 6): real TPU
+    operations resume on different topologies, so a TrainState saved from
+    an 8-device run must restore and CONTINUE on (a) a hierarchical
+    (dcn=2, ici=4) reshape — bit-level continuation, since that step is
+    proven identical to the flat step — and (b) 4 devices and (c) a single
+    device, where the documented per-shard RNG fold-in means trajectories
+    diverge in sampling but must stay in the converged band (a broken
+    restore resets to init-level loss immediately)."""
+    from mx_rcnn_tpu.utils.checkpoint import restore_state, save_checkpoint
+
+    cfg, model, tx, state = tiny_setup()
+    prefix = str(tmp_path / "xmesh")
+    mesh8 = device_mesh(8)
+    step8 = make_dp_train_step(model, cfg, tx, mesh8)
+    batch = stack_batches(8)
+    b8 = shard_batch(batch, mesh8)
+    s = replicate(jax.tree.map(jnp.copy, state), mesh8)
+    for _ in range(6):
+        s, m = step8(s, b8, KEY)
+    loss_pre = float(m["loss"])
+    save_checkpoint(prefix, 1, s)
+    saved_step = int(s.step)
+
+    # (a) flat-8 → (dcn=2, ici=4): restored run must match the
+    # uninterrupted flat run EXACTLY (the hier step ≡ flat step)
+    hier = device_mesh(8, dcn_size=2)
+    steph = make_dp_train_step(model, cfg, tx, hier)
+    sh = replicate(restore_state(jax.tree.map(jnp.copy, state), prefix, 1),
+                   hier)
+    assert int(sh.step) == saved_step
+    s_cont, m_cont = step8(s, b8, KEY)
+    sh, m_h = steph(sh, shard_batch(batch, hier), KEY)
+    np.testing.assert_allclose(float(m_h["loss"]), float(m_cont["loss"]),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s_cont.params),
+                    jax.tree.leaves(sh.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    # (b) resume on a 4-device mesh (2 images per shard)
+    mesh4 = device_mesh(4)
+    step4 = make_dp_train_step(model, cfg, tx, mesh4)
+    s4 = replicate(restore_state(jax.tree.map(jnp.copy, state), prefix, 1),
+                   mesh4)
+    assert int(s4.step) == saved_step
+    b4 = shard_batch(batch, mesh4)
+    for _ in range(3):
+        s4, m4 = step4(s4, b4, KEY)
+    assert int(s4.step) == saved_step + 3
+    # continuation, not a reset: stays in the trained band (init-level
+    # loss on this setup is >3x the converged loss)
+    assert float(m4["loss"]) < 2.0 * loss_pre + 0.2
+
+    # (c) resume on a single device with the same global batch
+    from mx_rcnn_tpu.core.train import make_train_step
+
+    step1 = jax.jit(make_train_step(model, cfg, tx))
+    s1 = restore_state(jax.tree.map(jnp.copy, state), prefix, 1)
+    assert int(s1.step) == saved_step
+    for _ in range(3):
+        s1, m1 = step1(s1, batch, KEY)
+    assert int(s1.step) == saved_step + 3
+    assert float(m1["loss"]) < 2.0 * loss_pre + 0.2
+
+
+@pytest.mark.slow
 def test_hierarchical_dcn_mesh_matches_flat_mesh():
     """A 2x4 (dcn, ici) mesh must produce the SAME step as the flat 8-device
     mesh: axis_index over both axes linearizes identically, so per-image
