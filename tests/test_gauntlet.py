@@ -4,11 +4,22 @@ Quick tier: generation invariants of ``synthetic_hard`` — determinism,
 class balance, the occlusion visibility floor, registration through config
 and ``load_gt_roidb``.
 
-The slow-tier pinned end-metric gate (train across seeds, assert the
-pinned mAP floor and seed-spread budget) lands together with the measured
-recipe — the floor/budget constants come from runs recorded in
-``docs/GAUNTLET.md``, so the recipe is calibrated first.
+Slow tier: the pinned end-metric regression gate.  Measured environment
+sensitivity matters here: the SAME seed-0 recipe scores 0.7632 on a plain
+single-CPU-device JAX and 0.7094 under the test harness's 8-virtual-device
+``xla_force_host_platform_device_count`` flag (different XLA CPU thread
+partitioning → different reduction numerics accumulating over 4000 steps).
+The gate therefore pins a one-sided FLOOR in its own environment rather
+than a cross-environment equality: a point-level accuracy regression (bad
+target assignment, broken NMS semantics, decode drift) costs far more
+than the environment wobble and lands as a hard failure.  The recorded
+3-seed table (``docs/gauntlet_results.json``, rendered in
+``docs/GAUNTLET.md``) is cross-checked for spread-budget compliance by a
+quick test.
 """
+
+import json
+import os
 
 import numpy as np
 import pytest
@@ -18,10 +29,17 @@ from mx_rcnn_tpu.data import load_gt_roidb
 from mx_rcnn_tpu.data.synthetic import (_HARD_PALETTE, HardSyntheticDataset,
                                         SyntheticDataset)
 
+# production recipe: 400 train imgs, 20 epochs, lr 3e-3, step 15, batch 2.
+# Seed-0 measured 0.7094 under the test harness (8 virtual CPU devices);
+# the floor sits ~0.04 under that — far above an untrained/broken model
+# (~0.0-0.3) and any point-level semantic regression.
+GATE_FLOOR = 0.67
+SPREAD_BUDGET = 0.02
+
 
 def test_hard_dataset_generation_invariants(tmp_path):
     ds = HardSyntheticDataset("train", str(tmp_path), "")
-    assert ds.num_images == 200 and ds.num_classes == 9
+    assert ds.num_images == 400 and ds.num_classes == 9
     ds_test = HardSyntheticDataset("test", str(tmp_path), "")
     assert ds_test.num_images == 100
     # deterministic: a fresh instance reproduces identical specs
@@ -66,9 +84,13 @@ def test_hard_dataset_occlusion_and_distractors_exist(tmp_path):
     and every image carries distractor rectangles."""
     ds = HardSyntheticDataset("train", str(tmp_path), "")
     h, w = ds.image_size
+    # distractor placement is best-effort (rejected when overlapping real
+    # objects): nearly every image carries some, totalling in the hundreds
+    counts = [len(s["distractors"]) for s in ds._specs]
+    assert sum(c == 0 for c in counts) < 0.05 * len(counts)
+    assert sum(counts) > 2 * len(counts)
     occluded = 0
     for spec in ds._specs:
-        assert len(spec["distractors"]) >= 1
         boxes = spec["boxes"].astype(int)
         owner = np.full((h, w), -1, np.int32)
         for k, (x1, y1, x2, y2) in enumerate(boxes):
@@ -77,7 +99,7 @@ def test_hard_dataset_occlusion_and_distractors_exist(tmp_path):
             area = (y2 - y1 + 1) * (x2 - x1 + 1)
             if (owner[y1:y2 + 1, x1:x2 + 1] == k).sum() < area:
                 occluded += 1
-    assert occluded > 50, f"only {occluded} occluded boxes in 200 images"
+    assert occluded > 100, f"only {occluded} occluded boxes in 400 images"
 
 
 def test_hard_dataset_registration(tmp_path):
@@ -90,7 +112,7 @@ def test_hard_dataset_registration(tmp_path):
     assert len(roidb) == 100
     # train mode: flip doubles the records
     _, train_roidb = load_gt_roidb(cfg, training=True)
-    assert len(train_roidb) == 400
+    assert len(train_roidb) == 800
 
 
 def test_hard_dataset_render_distinct_classes(tmp_path):
@@ -116,6 +138,38 @@ def test_hard_dataset_render_distinct_classes(tmp_path):
             assert mean.argmax() == base.argmax(), (mean, base)
             checked += 1
     assert checked > 30
+
+
+def test_recorded_gauntlet_results_within_budget():
+    """The committed gauntlet table must satisfy its own contract: >= 3
+    seeds for e2e/tiny, per-seed spread within SPREAD_BUDGET, and every
+    seed above the gate floor."""
+    from mx_rcnn_tpu.tools.gauntlet import summarize
+
+    path = os.path.join(os.path.dirname(__file__), "..", "docs",
+                        "gauntlet_results.json")
+    with open(path) as f:
+        records = json.load(f)
+    s = summarize(records)["e2e/tiny"]
+    assert len(s["seeds"]) >= 3
+    assert s["spread"] <= SPREAD_BUDGET, s
+    assert min(s["mAPs"]) >= GATE_FLOOR, s
+
+
+@pytest.mark.slow
+def test_gauntlet_pinned_seed0_regression_gate(tmp_path):
+    """Train seed 0 with the production gauntlet recipe from scratch and
+    assert the mAP floor (see module docstring for why a one-sided floor
+    in this environment, not a cross-environment equality)."""
+    from mx_rcnn_tpu.tools.gauntlet import main as gauntlet_main
+
+    out = tmp_path / "results.json"
+    gauntlet_main(["--root", str(tmp_path), "--workdir",
+                   str(tmp_path / "work"), "--out", str(out),
+                   "--seeds", "0", "--mode", "e2e"])
+    with open(out) as f:
+        rec = json.load(f)[0]
+    assert rec["mAP"] >= GATE_FLOOR, rec
 
 
 def test_easy_dataset_unchanged(tmp_path):
