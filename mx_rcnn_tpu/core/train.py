@@ -200,14 +200,21 @@ def loss_and_metrics(
     variables = {"params": params, "batch_stats": batch_stats}
     k_anchor, k_rcnn = jax.random.split(key)
 
-    feat = model.apply(variables, batch.images, batch.im_info,
-                       method=model.features)
-    rpn_cls, rpn_box = model.apply(variables, feat, method=model.rpn_raw)
+    # named_scope on each stage: jax.profiler traces then attribute device
+    # time per stage (tools/profile_step.py --trace_summary), the loop-free
+    # fallback to the unrolled-chain timing
+    with jax.named_scope("backbone"):
+        feat = model.apply(variables, batch.images, batch.im_info,
+                           method=model.features)
+    with jax.named_scope("rpn_head"):
+        rpn_cls, rpn_box = model.apply(variables, feat,
+                                       method=model.rpn_raw)
     _, fh, fw, _ = feat.shape
     anchors = model.anchors_for(fh, fw)
 
-    rpn_cls_loss, rpn_bbox_loss, rpn_metrics = _rpn_losses(
-        model, rpn_cls, rpn_box, anchors, batch, k_anchor, cfg)
+    with jax.named_scope("rpn_losses"):
+        rpn_cls_loss, rpn_bbox_loss, rpn_metrics = _rpn_losses(
+            model, rpn_cls, rpn_box, anchors, batch, k_anchor, cfg)
 
     # ---- proposals (no gradient; ref Proposal/proposal_target CustomOps
     # define no backward) ---------------------------------------------------
@@ -224,10 +231,12 @@ def loss_and_metrics(
             min_size=tr.rpn_min_size)
         return rois, roi_valid
 
-    rois, rois_valid = jax.vmap(one_img)(fg_scores, rpn_box_sg,
-                                         batch.im_info)
-    rcnn_cls_loss, rcnn_bbox_loss, rcnn_metrics = _rcnn_losses(
-        model, variables, feat, rois, rois_valid, batch, k_rcnn, cfg)
+    with jax.named_scope("proposal"):
+        rois, rois_valid = jax.vmap(one_img)(fg_scores, rpn_box_sg,
+                                             batch.im_info)
+    with jax.named_scope("rcnn_losses"):
+        rcnn_cls_loss, rcnn_bbox_loss, rcnn_metrics = _rcnn_losses(
+            model, variables, feat, rois, rois_valid, batch, k_rcnn, cfg)
 
     total = rpn_cls_loss + rpn_bbox_loss + rcnn_cls_loss + rcnn_bbox_loss
     # the six reference metrics (rcnn/core/metric.py)
