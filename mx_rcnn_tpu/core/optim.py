@@ -114,11 +114,20 @@ def make_optimizer(
                         cfg.default.lr_factor,
                         warmup_step=cfg.default.warmup_step,
                         warmup_lr=cfg.default.warmup_lr)
+    # momentum accumulator dtype: bfloat16 halves optimizer-state HBM and
+    # bandwidth (config.default.momentum_dtype — TPU addition; float32 =
+    # exact reference semantics); unknown spellings raise
+    from mx_rcnn_tpu.config import validate_dtype_string
+
+    md = validate_dtype_string(cfg.default.momentum_dtype,
+                               "default__momentum_dtype")
+    acc_dtype = jnp.bfloat16 if md == "bfloat16" else None
     sgd = optax.chain(
         # ref optimizer_params: elementwise clip_gradient=5 before update
         optax.clip(cfg.default.clip_gradient),
         optax.add_decayed_weights(cfg.default.wd),
-        optax.sgd(learning_rate=sched, momentum=cfg.default.momentum),
+        optax.sgd(learning_rate=sched, momentum=cfg.default.momentum,
+                  accumulator_dtype=acc_dtype),
     )
     mask = frozen_mask(params, frozen_prefixes)
     return optax.chain(

@@ -187,6 +187,20 @@ def _rcnn_losses(model: FasterRCNN, variables, feat, rois, rois_valid,
     return cls_loss, bbox_loss, metrics
 
 
+def _backbone_features(model: FasterRCNN, variables, batch, cfg: Config):
+    """Backbone forward shared by the three training objectives; with
+    ``cfg.train.remat_backbone`` the activations are rematerialized in the
+    backward pass (jax.checkpoint) — numerically identical gradients,
+    HBM for FLOPs (pinned equal by test)."""
+
+    def f(v, images):
+        return model.apply(v, images, batch.im_info, method=model.features)
+
+    if cfg.train.remat_backbone:
+        f = jax.checkpoint(f)
+    return f(variables, batch.images)
+
+
 def loss_and_metrics(
     model: FasterRCNN,
     params,
@@ -204,8 +218,7 @@ def loss_and_metrics(
     # time per stage (tools/profile_step.py --trace_summary), the loop-free
     # fallback to the unrolled-chain timing
     with jax.named_scope("backbone"):
-        feat = model.apply(variables, batch.images, batch.im_info,
-                           method=model.features)
+        feat = _backbone_features(model, variables, batch, cfg)
     with jax.named_scope("rpn_head"):
         rpn_cls, rpn_box = model.apply(variables, feat,
                                        method=model.rpn_raw)
@@ -256,8 +269,7 @@ def loss_and_metrics_rpn(
     ``train_rpn.py``): backbone → RPN heads → anchor targets → two losses.
     Shares ``_rpn_losses`` with the e2e objective."""
     variables = {"params": params, "batch_stats": batch_stats}
-    feat = model.apply(variables, batch.images, batch.im_info,
-                       method=model.features)
+    feat = _backbone_features(model, variables, batch, cfg)
     rpn_cls, rpn_box = model.apply(variables, feat, method=model.rpn_raw)
     _, fh, fw, _ = feat.shape
     anchors = model.anchors_for(fh, fw)
@@ -279,8 +291,7 @@ def loss_and_metrics_rcnn(
     2/4; ref ``train_rcnn.py`` + host-side ``sample_rois``).  Shares
     ``_rcnn_losses`` with the e2e objective."""
     variables = {"params": params, "batch_stats": batch_stats}
-    feat = model.apply(variables, batch.images, batch.im_info,
-                       method=model.features)
+    feat = _backbone_features(model, variables, batch, cfg)
     cls_loss, bbox_loss, metrics = _rcnn_losses(
         model, variables, feat, batch.rois, batch.rois_valid, batch, key,
         cfg)

@@ -223,6 +223,10 @@ class FasterRCNN(nn.Module):
 
 def build_model(cfg: Config) -> FasterRCNN:
     """Construct the model from a Config (ref generate_config wiring)."""
+    from mx_rcnn_tpu.config import validate_dtype_string
+
+    validate_dtype_string(cfg.network.compute_dtype,
+                          "network__compute_dtype")
     return FasterRCNN(
         network=cfg.network.name,
         num_classes=cfg.num_classes,
