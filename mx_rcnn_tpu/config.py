@@ -185,6 +185,11 @@ class DefaultConfig:
     # (0 disables), plus an optional disk tier directory
     image_cache_mb: int = 2048
     image_cache_dir: str = ""
+    # process-parallel decode pool (data/decode_pool.py): worker process
+    # count, 0 = decode in-thread.  Workers share image_cache_dir's disk
+    # tier; pointless on a 1-core host (docs/PERF.md scaling table) but
+    # the lever for feeding multiple chips from a many-core host
+    decode_procs: int = 0
 
 
 @dataclass(frozen=True)

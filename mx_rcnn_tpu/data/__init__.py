@@ -17,7 +17,8 @@ TPU-native differences:
 from mx_rcnn_tpu.data.cache import DecodedImageCache  # noqa: F401
 from mx_rcnn_tpu.data.image import load_and_transform, resize_to_bucket  # noqa: F401
 from mx_rcnn_tpu.data.loader import (AnchorLoader, ROITestLoader,  # noqa: F401
-                                     TestLoader, cache_from_config)
+                                     TestLoader, cache_from_config,
+                                     decode_pool_from_config)
 from mx_rcnn_tpu.data.roidb import IMDB, filter_roidb, merge_roidbs  # noqa: F401
 from mx_rcnn_tpu.data.pascal_voc import PascalVOC  # noqa: F401
 from mx_rcnn_tpu.data.coco import COCODataset  # noqa: F401

@@ -41,6 +41,18 @@ def cache_from_config(cfg: Config) -> DecodedImageCache | None:
                              cache_dir=d.image_cache_dir or None)
 
 
+def decode_pool_from_config(cfg: Config):
+    """Build the process decode pool the config asks for (None = in-thread
+    decode).  Callers own the pool: close() it when the loaders are done
+    (``tools/train.py`` wraps fit in try/finally)."""
+    d = cfg.default
+    if d.decode_procs <= 0:
+        return None
+    from mx_rcnn_tpu.data.decode_pool import DecodePool
+
+    return DecodePool(d.decode_procs, cache_dir=d.image_cache_dir or None)
+
+
 class _ImageSource:
     """Shared decode/cache plumbing for the three loaders.
 

@@ -21,7 +21,8 @@ import jax
 from mx_rcnn_tpu.config import Config, generate_config
 from mx_rcnn_tpu.core.fit import fit
 from mx_rcnn_tpu.core.train import setup_training
-from mx_rcnn_tpu.data import AnchorLoader, cache_from_config, load_gt_roidb
+from mx_rcnn_tpu.data import (AnchorLoader, cache_from_config,
+                              decode_pool_from_config, load_gt_roidb)
 from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.utils.checkpoint import restore_state
 
@@ -60,17 +61,19 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
 
     n_total = cfg.train.batch_images * num_devices
     cache = cache_from_config(cfg)
+    decode_pool = decode_pool_from_config(cfg)
     if mode == "rcnn":
         from mx_rcnn_tpu.data.loader import ROIIter
 
         if proposals is None:
             raise ValueError("mode='rcnn' requires precomputed proposals")
         loader = ROIIter(roidb, cfg, proposals, batch_images=n_total,
-                         shuffle=cfg.train.shuffle, seed=seed, cache=cache)
+                         shuffle=cfg.train.shuffle, seed=seed, cache=cache,
+                         decode_pool=decode_pool)
     else:
         loader = AnchorLoader(roidb, cfg, batch_images=n_total,
                               shuffle=cfg.train.shuffle, seed=seed,
-                              cache=cache)
+                              cache=cache, decode_pool=decode_pool)
     steps_per_epoch = max(len(loader), 1)
     logger.info("%d batches/epoch (global batch %d)", steps_per_epoch,
                 n_total)
@@ -142,10 +145,15 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
             f"dcn_size={dcn_size} requires num_devices > 1 (got "
             f"{num_devices}) — the (dcn, ici) mesh only exists in "
             "multi-device training")
-    state = fit(model, cfg, state, tx, loader, end_epoch, key,
-                begin_epoch=begin_epoch, prefix=prefix, frequent=frequent,
-                mesh=mesh, mode=mode, profile_dir=profile_dir,
-                stop_flag=stop_flag, device_cache=device_cache)
+    try:
+        state = fit(model, cfg, state, tx, loader, end_epoch, key,
+                    begin_epoch=begin_epoch, prefix=prefix,
+                    frequent=frequent, mesh=mesh, mode=mode,
+                    profile_dir=profile_dir, stop_flag=stop_flag,
+                    device_cache=device_cache)
+    finally:
+        if decode_pool is not None:
+            decode_pool.close()
     return state
 
 

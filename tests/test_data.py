@@ -353,6 +353,19 @@ def test_cache_invalidates_on_source_file_change(tmp_path):
     assert len(_glob.glob(str(tmp_path / "c" / "*.npy"))) == 1
 
 
+def test_decode_pool_from_config():
+    from mx_rcnn_tpu.data import decode_pool_from_config
+
+    cfg = generate_config("tiny", "synthetic")
+    assert decode_pool_from_config(cfg) is None  # default: in-thread
+    pool = decode_pool_from_config(
+        generate_config("tiny", "synthetic", default__decode_procs=1))
+    try:
+        assert pool is not None and pool.num_procs == 1
+    finally:
+        pool.close()
+
+
 @pytest.mark.slow
 def test_decode_pool_identical_batches(tmp_path):
     """A DecodePool-backed loader must yield batches identical to the
