@@ -53,7 +53,10 @@ _LAST_VERIFIED = {
     "value": 74.8,              # BENCH_r02.json — driver-captured
     "sustained": 72.7,          # docs/PERF.md r3 in-session (device-rate)
     "source": ("last verified: BENCH_r02 driver capture (74.8 imgs/s); "
-               "sustained from docs/PERF.md round-3 in-session run"),
+               "sustained from docs/PERF.md round-3 in-session run; both "
+               "measured at TRAIN pre-NMS 12000 — the bench now runs the "
+               "adopted 6000 recipe (~16% faster), so a live number is "
+               "expected HIGHER than these"),
 }
 
 
