@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Accuracy gauntlet recipe: seed-stable mAP on the hard synthetic set plus
+# the two ablations (no downloads needed; CPU-runnable).  Results append to
+# data/gauntlet/results.json / ablations.json; --markdown renders the
+# docs table.  See docs/GAUNTLET.md for the recorded numbers and the
+# environment-sensitivity note before comparing across machines.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m mx_rcnn_tpu.tools.gauntlet \
+  --seeds 0 1 2 --mode e2e \
+  --markdown docs/GAUNTLET.md "$@"
+
+python -m mx_rcnn_tpu.tools.gauntlet \
+  --seeds 0 1 2 --mode prenms \
+  --out data/gauntlet/ablations.json "$@"
+
+python -m mx_rcnn_tpu.tools.gauntlet \
+  --seeds 0 1 --mode alternate \
+  --out data/gauntlet/ablations.json "$@"
