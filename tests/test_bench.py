@@ -131,6 +131,41 @@ def test_timed_out_child_with_result_is_salvaged(monkeypatch):
     assert "degraded" not in out
 
 
+def test_sigterm_during_supervision_emits_degraded_line():
+    """A harness that loses patience and SIGTERMs the supervisor must
+    still get a parseable degraded JSON line, not silence."""
+    import os
+    import signal
+    import time as _time
+
+    repo = __file__.rsplit("/tests/", 1)[0]
+    code = (
+        "import sys\n"
+        "sys.argv = ['bench.py']\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "import bench\n"
+        "bench.supervise = lambda: (_ for _ in ()).throw(SystemExit)  # unused\n"
+        "import json, types\n"
+        "def fake_supervise(child_cmd=None):\n"
+        "    import time\n"
+        "    time.sleep(120)\n"
+        "bench.supervise = fake_supervise\n"
+        "bench.main()\n"
+    )
+    env = {**os.environ, "BENCH_RETRY_WINDOW_S": "0"}
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True, env=env)
+    _time.sleep(3.0)  # let it install the handler and enter the sleep
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=30)
+    assert proc.returncode == 0
+    lines = [ln for ln in out.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1
+    parsed = json.loads(lines[0])
+    assert parsed["degraded"] is True
+    assert "signal" in parsed["failure"]
+
+
 def test_parse_result_rejects_garbage():
     assert bench._parse_result("") is None
     assert bench._parse_result("not json\nstill not json") is None
