@@ -1,7 +1,10 @@
 """Benchmark: ResNet-101 Faster R-CNN end-to-end training throughput.
 
 Prints ONE JSON line:
-  {"metric": "imgs_per_sec_per_chip", "value": N, "unit": "imgs/s", "vs_baseline": N}
+  {"metric": "imgs_per_sec_per_chip", "value": N, "unit": "imgs/s",
+   "vs_baseline": N, "measured": true}
+(``"measured"`` is the provenance discriminator: false — with
+``"value": null`` — on the degraded path below.)
 
 Outage protocol (VERDICT r03 item 1): the tunneled chip can hang during
 backend init or go Unavailable for hours; round 3's bench died with a bare
@@ -9,11 +12,14 @@ traceback and produced no number.  The default entry point is therefore a
 SUPERVISOR that runs the measurement in a fresh subprocess per attempt
 (``bench.py --once``) with a hard per-attempt timeout (a hung backend init
 cannot wedge the run), retries transient failures with backoff across a
-long window (``BENCH_RETRY_WINDOW_S``, default 3 h), and — if the window
-closes without a measurement — emits a STRUCTURED degraded line instead of
-a traceback: the last independently verified numbers plus
-``"degraded": true``, ``"failure"`` and ``"value_source"`` so the record
-is honest about its provenance.  Non-transient child errors (real bugs)
+long window (``BENCH_RETRY_WINDOW_S``, default 1 h — kept short because
+the driver's own bench timeout would kill a longer wait anyway; raise it
+for unattended captures, see ``supervise()``), and — if the window closes
+without a measurement — emits a STRUCTURED degraded line instead of a
+traceback: ``"value": null`` with ``"measured": false``/``"degraded":
+true``, the last independently verified numbers under ``last_verified_*``
+keys, plus ``"failure"`` and ``"value_source"`` so the record is honest
+about its provenance.  Non-transient child errors (real bugs)
 bail to the degraded line immediately instead of burning the window.
 
 Baseline (BASELINE.md): the reference's community-reported throughput on a
@@ -270,6 +276,7 @@ def run_once() -> None:
         "value": round(imgs_per_sec, 3),
         "unit": "imgs/s",
         "vs_baseline": round(imgs_per_sec / p100_baseline, 3),
+        "measured": True,
     }
     if sustained is not None:
         out["sustained_imgs_per_sec"] = round(sustained, 3)
@@ -290,13 +297,20 @@ def _parse_result(stdout: str):
 
 
 def _degraded(failure: str) -> dict:
+    # ``value`` is null, NOT the historical number: a consumer keying on
+    # metric/value alone must not record an unmeasured figure as if live
+    # (advisor r4).  The last independently verified numbers move to
+    # explicit ``last_verified_*`` keys with their provenance.
     return {
         "metric": "imgs_per_sec_per_chip",
-        "value": _LAST_VERIFIED["value"],
+        "value": None,
         "unit": "imgs/s",
-        "vs_baseline": round(_LAST_VERIFIED["value"] / 3.0, 3),
-        "sustained_imgs_per_sec": _LAST_VERIFIED["sustained"],
+        "vs_baseline": None,
+        "measured": False,
         "degraded": True,
+        "last_verified_value": _LAST_VERIFIED["value"],
+        "last_verified_vs_baseline": round(_LAST_VERIFIED["value"] / 3.0, 3),
+        "last_verified_sustained_imgs_per_sec": _LAST_VERIFIED["sustained"],
         "value_source": _LAST_VERIFIED["source"],
         "failure": failure[:500],
     }

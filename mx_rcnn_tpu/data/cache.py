@@ -27,7 +27,6 @@ Thread-safe: the loader's prefetch pool calls ``load`` concurrently.
 
 from __future__ import annotations
 
-import glob
 import hashlib
 import os
 import threading
@@ -71,6 +70,15 @@ class DecodedImageCache:
         self._ram: "OrderedDict[str, np.ndarray]" = OrderedDict()
         self._ram_used = 0
         self._lock = threading.Lock()
+        # stable-prefix -> set of versioned filenames currently on disk;
+        # built from ONE os.listdir on first write, then kept in sync by
+        # the writers in this process.  Eviction of superseded versions is
+        # an O(1) lookup here — the previous per-miss glob over the whole
+        # cache_dir made a cold COCO-scale first epoch O(N^2) in name
+        # comparisons (advisor r4).  Stale entries (another process wrote
+        # concurrently) only cost a missed best-effort eviction, the same
+        # race the glob had.
+        self._disk_index: Optional[dict] = None
         self.hits = 0
         self.misses = 0
 
@@ -98,6 +106,49 @@ class DecodedImageCache:
         version = hashlib.sha1(stamp.encode()).hexdigest()[:16]
         return f"{digest}-{stem}{'-f' if flipped else ''}.{version}"
 
+    def _build_disk_index(self) -> dict:
+        """One listdir pass over cache_dir → {stable prefix: {versioned
+        filenames}} — built lazily on the first disk write, then kept in
+        sync by :meth:`_record_version`."""
+        index: dict = {}
+        try:
+            names = os.listdir(self.cache_dir)
+        except OSError:
+            names = []
+        hexdigits = set("0123456789abcdef")
+        for name in names:
+            if not name.endswith(".npy"):
+                continue
+            stem = name[:-len(".npy")]
+            head, _, version = stem.rpartition(".")
+            # only properly-versioned entries (16-hex suffix) are indexed;
+            # anything else is either a pre-versioning legacy name
+            # (cleared by direct unlink in the writer) or a foreign file
+            # we must not touch.  The check also keeps dotted image stems
+            # (`img.v2.jpg`) from being split at the wrong dot.
+            if head and len(version) == 16 and set(version) <= hexdigits:
+                index.setdefault(head, set()).add(name)
+        return index
+
+    def _record_version(self, prefix: str, fn: str) -> list:
+        """Record ``fn`` as the current on-disk version for ``prefix``;
+        return the superseded sibling filenames the caller should unlink.
+        The listdir-sized index build runs OUTSIDE the lock (it would
+        otherwise stall every _ram_get for hundreds of ms on a warm
+        COCO-scale dir); the dict/set mutations run UNDER it (the loader's
+        prefetch threads can miss on the same key concurrently)."""
+        if self._disk_index is None:
+            built = self._build_disk_index()
+            with self._lock:
+                if self._disk_index is None:
+                    self._disk_index = built
+        with self._lock:
+            entries = self._disk_index.setdefault(prefix, set())
+            stale = [n for n in entries if n != fn]
+            entries.difference_update(stale)
+            entries.add(fn)
+        return stale
+
     def _ram_get(self, key: str) -> Optional[np.ndarray]:
         with self._lock:
             img = self._ram.get(key)
@@ -123,16 +174,31 @@ class DecodedImageCache:
         (unpadded).  The caller derives im_scale via :func:`plan_scale`."""
         key = self._key(path, flipped, scale, max_size, bucket)
         img = self._ram_get(key)
+        from_disk = False
         if img is None and self.cache_dir:
             fp = os.path.join(self.cache_dir, key + ".npy")
             if os.path.exists(fp):
                 try:
                     img = np.load(fp)
+                    from_disk = True
                 except Exception:
                     img = None  # torn/corrupt file: fall through to decode
         if img is not None:
             self.hits += 1
             self._ram_put(key, img)
+            if from_disk and self._disk_index is not None:
+                # a disk HIT on a version this process's index doesn't
+                # know can mean a sibling process wrote the new version
+                # (so only ITS index would evict our stale one — it never
+                # writes again after we start hitting its file).  The
+                # index is already built, so this is an O(1) check that
+                # closes the cross-process leak at zero listdir cost.
+                prefix = key.rsplit(".", 1)[0]
+                for old in self._record_version(prefix, key + ".npy"):
+                    try:
+                        os.unlink(os.path.join(self.cache_dir, old))
+                    except OSError:  # already gone
+                        pass
             return img
         self.misses += 1
         img, _ = load_resized_uint8(path, flipped, scale, max_size, bucket)
@@ -150,17 +216,21 @@ class DecodedImageCache:
                 # prefix, different mtime/size version) so regenerating the
                 # dataset N times doesn't keep N dead copies on disk; also
                 # the pre-versioning legacy name `prefix.npy`, which the
-                # new keys can never read again
+                # new keys can never read again.  Sibling versions come
+                # from the one-time directory index (O(1) per write) — not
+                # a per-miss glob, which made cold first epochs O(N^2)
                 prefix = key.rsplit(".", 1)[0]
-                pat = os.path.join(glob.escape(self.cache_dir),
-                                   glob.escape(prefix) + ".*.npy")
-                legacy = os.path.join(self.cache_dir, prefix + ".npy")
-                for old in glob.glob(pat) + [legacy]:
-                    if old != fp:
-                        try:
-                            os.unlink(old)
-                        except OSError:  # already gone / never existed
-                            pass
+                for old in self._record_version(prefix,
+                                                os.path.basename(fp)):
+                    try:
+                        os.unlink(os.path.join(self.cache_dir, old))
+                    except OSError:  # already gone
+                        pass
+                try:  # targeted single unlink, no directory scan
+                    os.unlink(os.path.join(self.cache_dir,
+                                           prefix + ".npy"))
+                except OSError:  # never existed (the common case)
+                    pass
             except OSError:  # disk full etc. — the cache stays best-effort
                 if os.path.exists(tmp):
                     os.unlink(tmp)

@@ -18,6 +18,8 @@ TPU-native differences:
 
 from __future__ import annotations
 
+import logging
+
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterable, Iterator, List, Sequence, Tuple
@@ -50,7 +52,18 @@ def decode_pool_from_config(cfg: Config):
         return None
     from mx_rcnn_tpu.data.decode_pool import DecodePool
 
-    return DecodePool(d.decode_procs, cache_dir=d.image_cache_dir or None)
+    # decode runs in the worker processes, so the RAM tier must live there
+    # too: split the configured budget across workers (the parent's cache
+    # is never consulted on this path — advisor r4).  The disk tier stays
+    # shared via cache_dir.
+    per_worker = (d.image_cache_mb << 20) // d.decode_procs
+    if d.image_cache_mb > 0:
+        logging.getLogger("mx_rcnn_tpu").info(
+            "decode_procs=%d: image_cache_mb=%d RAM tier moves into the "
+            "workers at %d MB each (total RSS budget unchanged)",
+            d.decode_procs, d.image_cache_mb, per_worker >> 20)
+    return DecodePool(d.decode_procs, cache_dir=d.image_cache_dir or None,
+                      ram_bytes=per_worker)
 
 
 class _ImageSource:

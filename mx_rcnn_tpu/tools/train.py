@@ -60,8 +60,11 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
     logger.info("[%s] training on %d roidb images", mode, len(roidb))
 
     n_total = cfg.train.batch_images * num_devices
-    cache = cache_from_config(cfg)
     decode_pool = decode_pool_from_config(cfg)
+    # with a decode pool the cache lives IN the workers (loader.py —
+    # decode_pool_from_config splits the RAM budget across them); a
+    # parent-side cache would be dead weight the pool path never consults
+    cache = None if decode_pool is not None else cache_from_config(cfg)
     if mode == "rcnn":
         from mx_rcnn_tpu.data.loader import ROIIter
 

@@ -70,8 +70,14 @@ def test_hung_child_is_killed_and_degraded(monkeypatch):
     assert out["degraded"] is True
     assert "timeout" in out["failure"]
     assert out["metric"] == "imgs_per_sec_per_chip"
-    assert out["value"] == bench._LAST_VERIFIED["value"]
-    assert out["sustained_imgs_per_sec"] == bench._LAST_VERIFIED["sustained"]
+    # value is null on the degraded path so naive metric/value consumers
+    # cannot mistake a historical number for a live one (advisor r4); the
+    # historical figures live under explicit last_verified_* keys
+    assert out["value"] is None
+    assert out["measured"] is False
+    assert out["last_verified_value"] == bench._LAST_VERIFIED["value"]
+    assert out["last_verified_sustained_imgs_per_sec"] == \
+        bench._LAST_VERIFIED["sustained"]
     assert "value_source" in out
     json.dumps(out)  # the degraded line must itself be valid JSON content
 
@@ -120,7 +126,10 @@ def test_timed_out_child_with_result_is_salvaged(monkeypatch):
     (the tunnel's known pathology) still measured — its result must be
     used, not thrown away."""
     monkeypatch.setenv("BENCH_RETRY_WINDOW_S", "0")
-    monkeypatch.setenv("BENCH_ATTEMPT_TIMEOUT_S", "3")
+    # generous attempt timeout: the child must manage to PRINT its JSON
+    # before the kill, and interpreter startup alone can exceed 3 s when
+    # the box is loaded (observed under the on-chip battery)
+    monkeypatch.setenv("BENCH_ATTEMPT_TIMEOUT_S", "15")
     script = (
         "import json, sys, time\n"
         f"print(json.dumps({GOOD!r}), flush=True)\n"
@@ -148,6 +157,11 @@ def test_sigterm_during_supervision_emits_degraded_line():
         "import json, types\n"
         "def fake_supervise(child_cmd=None):\n"
         "    import time\n"
+        # handler is installed by main() BEFORE supervise runs, so this
+        # marker tells the parent it is safe to fire the SIGTERM — a fixed
+        # pre-signal sleep flakes when the box is loaded (chip battery
+        # saturating the single core slowed interpreter startup past 3 s)
+        "    print('READY', flush=True)\n"
         "    time.sleep(120)\n"
         "bench.supervise = fake_supervise\n"
         "bench.main()\n"
@@ -155,7 +169,7 @@ def test_sigterm_during_supervision_emits_degraded_line():
     env = {**os.environ, "BENCH_RETRY_WINDOW_S": "0"}
     proc = subprocess.Popen([sys.executable, "-c", code],
                             stdout=subprocess.PIPE, text=True, env=env)
-    _time.sleep(3.0)  # let it install the handler and enter the sleep
+    assert proc.stdout.readline().strip() == "READY"
     proc.send_signal(signal.SIGTERM)
     out, _ = proc.communicate(timeout=30)
     assert proc.returncode == 0
