@@ -70,6 +70,12 @@ class TrainConfig:
     # numerically identical gradients (pinned by test); enables larger
     # per-chip batches when activations are the memory wall
     remat_backbone: bool = False
+    # ROIAlign backend for the TRAIN step: 'auto'/'jnp' → the einsum pair
+    # (measured FASTER in the full step: the fused kernel wins isolated
+    # but pays ~13 ms in custom-call boundary layout copies + lost XLA
+    # fusion — ops/roi_pool.py roi_align_batched docstring has the
+    # numbers); 'pallas' → the experimental VMEM-fused kernel
+    roi_align_backend: str = "auto"
 
 
 @dataclass(frozen=True)

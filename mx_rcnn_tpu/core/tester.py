@@ -121,6 +121,26 @@ class Predictor:
         return self._forward("rois", (images, im_info, rois, rois_valid),
                              (0, 1, 0, 0), make_fn)
 
+    def rpn(self, images: np.ndarray, im_info: np.ndarray):
+        """RPN-only proposal forward (ref ``generate_proposals`` — the
+        alternate-training stage 1/3 inference): returns device arrays
+        (rois (N, R, 4), scores (N, R), valid (N, R)).  Mesh-sharded like
+        every other predictor mode, which the reference's single-GPU
+        proposal dump never was."""
+        model = self.model
+        pre = self.cfg.test.proposal_pre_nms_top_n
+        post = self.cfg.test.proposal_post_nms_top_n
+
+        def make_fn():
+            @jax.jit
+            def fn(variables, images, im_info):
+                return model.apply(variables, images, im_info, pre, post,
+                                   method=model.rpn_proposals)
+
+            return fn
+
+        return self._forward("rpn_only", (images, im_info), (0, 1), make_fn)
+
     def raw_batch(self, batch):
         """Dispatch a loader batch: an RCNNBatch (carries ``rois`` from
         precomputed proposals) runs the RCNN-only path; a plain Batch runs
@@ -134,6 +154,18 @@ class Predictor:
         rois, roi_valid, cls_prob, deltas = self.raw(images, im_info)
         return (np.asarray(rois), np.asarray(roi_valid),
                 np.asarray(cls_prob), np.asarray(deltas))
+
+
+def tiled_bbox_stats(cfg: Config, num_classes: int):
+    """(stds, means) tiled per class for delta de-normalization — THE
+    decode-time half of the one-convention invariant (module docstring);
+    every eval/demo/dryrun caller must use this single helper so the
+    convention cannot fork."""
+    stds = jnp.tile(jnp.asarray(cfg.train.bbox_stds, jnp.float32),
+                    num_classes)
+    means = jnp.tile(jnp.asarray(cfg.train.bbox_means, jnp.float32),
+                     num_classes)
+    return stds, means
 
 
 @jax.jit
@@ -210,10 +242,7 @@ def im_detect_batch(
     """
     n, r, c4 = deltas.shape
     num_classes = c4 // 4
-    stds = jnp.tile(jnp.asarray(cfg.train.bbox_stds, jnp.float32),
-                    num_classes)
-    means = jnp.tile(jnp.asarray(cfg.train.bbox_means, jnp.float32),
-                     num_classes)
+    stds, means = tiled_bbox_stats(cfg, num_classes)
     boxes_b, scores_b = map(np.asarray, _decode_batch(
         jnp.asarray(rois), jnp.asarray(roi_valid), jnp.asarray(cls_prob),
         jnp.asarray(deltas), jnp.asarray(im_info), jnp.asarray(scales),
@@ -239,10 +268,7 @@ def pred_eval(predictor: Predictor, test_loader, imdb, cfg: Config,
         for _ in range(num_classes)
     ]
     thresh = cfg.test.score_thresh
-    stds = jnp.tile(jnp.asarray(cfg.train.bbox_stds, jnp.float32),
-                    num_classes)
-    means = jnp.tile(jnp.asarray(cfg.train.bbox_means, jnp.float32),
-                     num_classes)
+    stds, means = tiled_bbox_stats(cfg, num_classes)
     done = 0
     for batch, indices, scales in test_loader:
         # device arrays stay on device between forward and postprocess;
@@ -295,29 +321,19 @@ def pred_eval(predictor: Predictor, test_loader, imdb, cfg: Config,
     return results
 
 
-def generate_proposals(model: FasterRCNN, variables, test_loader, cfg: Config
-                       ) -> List[np.ndarray]:
+def generate_proposals(model: FasterRCNN, variables, test_loader, cfg: Config,
+                       mesh=None) -> List[np.ndarray]:
     """RPN-only proposal dump for alternate training
     (ref ``generate_proposals`` writes rpn_data/*.pkl; here the (R, 5)
     [x1 y1 x2 y2 score] arrays are returned in roidb order and the caller
-    persists them)."""
+    persists them).  ``mesh``: optional data mesh — proposal generation
+    shards over chips exactly like eval (:class:`Predictor`)."""
     num_images = len(test_loader.roidb)
     proposals: List[np.ndarray] = [None] * num_images
-    fns: Dict[Tuple[int, ...], callable] = {}
-    pre = cfg.test.proposal_pre_nms_top_n
-    post = cfg.test.proposal_post_nms_top_n
+    predictor = Predictor(model, variables, cfg, mesh=mesh)
     for batch, indices, scales in test_loader:
-        shape = (tuple(batch.images.shape),
-                 np.dtype(batch.images.dtype).name)
-        if shape not in fns:
-            @jax.jit
-            def fn(variables, images, im_info):
-                return model.apply(variables, images, im_info, pre, post,
-                                   method=model.rpn_proposals)
-
-            fns[shape] = fn
-        rois, scores, roi_valid = map(np.asarray, fns[shape](
-            variables, jnp.asarray(batch.images), jnp.asarray(batch.im_info)))
+        rois, scores, roi_valid = map(np.asarray, predictor.rpn(
+            batch.images, batch.im_info))
         for j, i in enumerate(indices):
             valid = roi_valid[j]
             boxes = rois[j][valid] / scales[j]

@@ -38,7 +38,7 @@ from mx_rcnn_tpu.ops.losses import (
     weighted_smooth_l1,
 )
 from mx_rcnn_tpu.ops.proposal import propose
-from mx_rcnn_tpu.ops.roi_pool import roi_align
+from mx_rcnn_tpu.ops.roi_pool import roi_align_batched
 from mx_rcnn_tpu.ops.targets import anchor_target, proposal_target
 
 
@@ -157,9 +157,13 @@ def _rcnn_losses(model: FasterRCNN, variables, feat, rois, rois_valid,
         rois, rois_valid, batch.gt_boxes, batch.gt_classes, batch.gt_valid,
         jax.random.split(k_prop, n))
 
-    pooled = jax.vmap(
-        lambda f, r: roi_align(f, r, model.pooled_size, 1.0 / model.feat_stride)
-    )(feat, pt.rois)  # (N, B, ph, pw, C)
+    # 'auto' resolves to the einsum pair — the fused Pallas kernel wins
+    # isolated but loses ~13 ms to custom-call boundary costs in the full
+    # step (see ops/roi_pool.py roi_align_batched); 'pallas' opts in
+    backend = None if tr.roi_align_backend == "auto" else tr.roi_align_backend
+    pooled = roi_align_batched(feat, pt.rois, model.pooled_size,
+                               1.0 / model.feat_stride,
+                               backend=backend)  # (N, B, ph, pw, C)
     flat = pooled.reshape((-1,) + pooled.shape[2:])
     cls_logits, bbox_deltas = model.apply(
         variables, flat, True, method=model.roi_head,

@@ -16,7 +16,8 @@ from mx_rcnn_tpu.ops.boxes import (  # noqa: F401
 )
 from mx_rcnn_tpu.ops.nms import nms, nms_mask  # noqa: F401
 from mx_rcnn_tpu.ops.proposal import propose  # noqa: F401
-from mx_rcnn_tpu.ops.roi_pool import roi_align, roi_pool  # noqa: F401
+from mx_rcnn_tpu.ops.roi_pool import (roi_align, roi_align_batched,  # noqa: F401
+                                      roi_pool)
 from mx_rcnn_tpu.ops.targets import anchor_target, proposal_target  # noqa: F401
 from mx_rcnn_tpu.ops.losses import (  # noqa: F401
     smooth_l1,

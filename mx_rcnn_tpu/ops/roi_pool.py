@@ -61,6 +61,25 @@ def _interp_matrix(starts: jnp.ndarray, bin_sizes: jnp.ndarray, num_bins: int,
     return m.reshape(num_bins, sampling_ratio, size).mean(axis=1)
 
 
+def interp_matrices(rois: jnp.ndarray, ph: int, pw: int, h: int, w: int,
+                    spatial_scale: float, sampling_ratio: int):
+    """Per-ROI (wy (R, ph, H), wx (R, pw, W)) fp32 interpolation matrices
+    — the ONE place the ROI corner scaling / min-size clamp / bilinear
+    weights are defined, shared by the einsum and Pallas backends so
+    their weights cannot drift apart (parity tests pin them equal)."""
+    x1 = rois[:, 0].astype(jnp.float32) * spatial_scale
+    y1 = rois[:, 1].astype(jnp.float32) * spatial_scale
+    x2 = rois[:, 2].astype(jnp.float32) * spatial_scale
+    y2 = rois[:, 3].astype(jnp.float32) * spatial_scale
+    roi_w = jnp.maximum(x2 - x1, 1.0)
+    roi_h = jnp.maximum(y2 - y1, 1.0)
+    wy = jax.vmap(lambda s, b: _interp_matrix(s, b, ph, sampling_ratio, h))(
+        y1, roi_h / ph)
+    wx = jax.vmap(lambda s, b: _interp_matrix(s, b, pw, sampling_ratio, w))(
+        x1, roi_w / pw)
+    return wy, wx
+
+
 @functools.partial(
     jax.jit, static_argnames=("output_size", "spatial_scale", "sampling_ratio")
 )
@@ -90,19 +109,8 @@ def roi_align(
     h, w, _ = features.shape
     dtype = features.dtype
 
-    x1 = rois[:, 0].astype(jnp.float32) * spatial_scale
-    y1 = rois[:, 1].astype(jnp.float32) * spatial_scale
-    x2 = rois[:, 2].astype(jnp.float32) * spatial_scale
-    y2 = rois[:, 3].astype(jnp.float32) * spatial_scale
-    roi_w = jnp.maximum(x2 - x1, 1.0)
-    roi_h = jnp.maximum(y2 - y1, 1.0)
-
-    wy = jax.vmap(lambda s, b: _interp_matrix(s, b, ph, sampling_ratio, h))(
-        y1, roi_h / ph
-    )  # (R, ph, H)
-    wx = jax.vmap(lambda s, b: _interp_matrix(s, b, pw, sampling_ratio, w))(
-        x1, roi_w / pw
-    )  # (R, pw, W)
+    wy, wx = interp_matrices(rois, ph, pw, h, w, spatial_scale,
+                             sampling_ratio)
 
     # Two batched matmuls on the MXU.  Compute stays in the feature dtype:
     # in bf16 the weight rounding costs <0.3% of a pixel's bilinear frac —
@@ -120,6 +128,49 @@ def roi_align(
         cols = jnp.einsum("hwc,rtw->rhtc", features, wx, precision=prec)
         pooled = jnp.einsum("rhtc,rsh->rstc", cols, wy, precision=prec)
     return pooled.astype(dtype)
+
+
+def roi_align_batched(
+    features: jnp.ndarray,
+    rois: jnp.ndarray,
+    output_size: Tuple[int, int] = (14, 14),
+    spatial_scale: float = 1.0 / 16.0,
+    sampling_ratio: int = 2,
+    backend: str = None,
+) -> jnp.ndarray:
+    """Batched ROIAlign with backend dispatch.
+
+    features (N, H, W, C), rois (N, R, 4) → (N, R, ph, pw, C).
+
+    ``backend``: 'jnp' (the einsum pair above, vmapped — the DEFAULT) or
+    'pallas' (the VMEM-fused kernel in ``ops/roi_align_pallas.py``).  Both
+    build their bilinear weights with the same ``_interp_matrix``, so they
+    agree up to matmul rounding.
+
+    Why jnp is the default even on TPU (r5, measured on a v5e): isolated,
+    the fused kernel wins the forward (3.8 vs 4.1 ms) but still loses
+    fwd+bwd by ~2 ms (12.1 vs 10.1, after fixing a VMEM-spill that
+    initially made it 2x slower), and it loses
+    ~13 ms inside the full train step (38.6 vs 25.0 ms) — the opaque
+    custom-call boundary forces layout copies of the ~100 MB pooled /
+    cotangent tensors and blocks XLA's fusion across the op, costing far
+    more than the ~280 MB HBM intermediate it removes.  Kept behind the
+    flag (cfg.train.roi_align_backend) with parity tests as measured
+    groundwork; docs/PERF.md "Fused ROIAlign kernel" has the full record.
+    """
+    if backend is None:
+        backend = "jnp"
+    if backend == "pallas":
+        from mx_rcnn_tpu.ops.roi_align_pallas import roi_align_pallas
+
+        return roi_align_pallas(features, rois, output_size, spatial_scale,
+                                sampling_ratio)
+    if backend != "jnp":
+        raise ValueError(f"unknown roi_align backend {backend!r}")
+    return jax.vmap(
+        lambda f, r: roi_align(f, r, output_size, spatial_scale,
+                               sampling_ratio)
+    )(features, rois)
 
 
 @functools.partial(jax.jit, static_argnames=("output_size", "spatial_scale"))

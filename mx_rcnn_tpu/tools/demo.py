@@ -36,7 +36,7 @@ def detect_image(predictor: Predictor, img: np.ndarray, cfg: Config,
     postprocess semantics."""
     import jax.numpy as jnp
 
-    from mx_rcnn_tpu.core.tester import _postprocess_batch
+    from mx_rcnn_tpu.core.tester import _postprocess_batch, tiled_bbox_stats
 
     data, im_scale, bucket = resize_to_bucket(
         img, cfg.network.pixel_means, cfg.bucket.scale, cfg.bucket.max_size,
@@ -46,10 +46,7 @@ def detect_image(predictor: Predictor, img: np.ndarray, cfg: Config,
                          im_scale]], np.float32)
     rois, roi_valid, cls_prob, deltas = predictor.raw(data[None], im_info)
     num_classes = cls_prob.shape[-1]
-    stds = jnp.tile(jnp.asarray(cfg.train.bbox_stds, jnp.float32),
-                    num_classes)
-    means = jnp.tile(jnp.asarray(cfg.train.bbox_means, jnp.float32),
-                     num_classes)
+    stds, means = tiled_bbox_stats(cfg, num_classes)
     boxes_b, scores_b, keep_b = map(np.asarray, _postprocess_batch(
         rois, roi_valid, cls_prob, deltas, jnp.asarray(im_info),
         jnp.asarray([im_scale], dtype=jnp.float32), stds, means,

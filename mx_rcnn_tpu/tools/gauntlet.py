@@ -18,6 +18,13 @@ Each run appends a record to ``--out`` (JSON) keyed by
 (mode, network, seed); ``--markdown`` re-renders every record into a docs
 table.  Runs are resumable: existing (mode, network, seed) records are
 skipped unless ``--force``.
+
+``--compare MODE_A MODE_B`` upgrades the gate from the blunt absolute
+spread floor (±0.05-level sensitivity) to paired-seed A/B inference
+(VERDICT r04 item 4): both arms share seeds (common random numbers), and
+the tool reports per-seed deltas, the mean delta with a 95% t-CI, and a
+sign test, exiting 1 unless the CI lies inside ±``--budget`` (0.02
+default) — sensitive to ~0.01-0.02 effects with 3-5 seeds.
 """
 
 from __future__ import annotations
@@ -31,6 +38,8 @@ from typing import Dict, List
 import numpy as np
 
 logger = logging.getLogger("mx_rcnn_tpu")
+
+_MODES = ("e2e", "alternate", "prenms")
 
 
 def _base_cfg(args):
@@ -82,7 +91,8 @@ def run_one(args, mode: str, seed: int) -> Dict:
                         verbose=False)
     rec = {
         "mode": mode, "network": args.network, "seed": seed,
-        "epochs": args.epochs, "lr": args.lr,
+        "epochs": args.epochs, "lr": args.lr, "lr_step": args.lr_step,
+        "batch_images": args.batch_images,
         "mAP": round(float(results["mAP"]), 4),
         "per_class": {k: round(float(v), 4) for k, v in results.items()
                       if k != "mAP"},
@@ -118,6 +128,82 @@ def summarize(records: List[Dict]) -> Dict[str, Dict]:
             "spread": round(float(max(maps) - min(maps)), 4),
         }
     return out
+
+
+# two-sided 97.5% Student-t quantiles, df 1..30 (NIST tables); scipy is
+# not a dependency.  df > 30 falls back to the df=30 value — slightly
+# WIDER than the true quantile, so the equivalence gate errs conservative
+_T975 = {1: 12.706, 2: 4.303, 3: 3.182, 4: 2.776, 5: 2.571,
+         6: 2.447, 7: 2.365, 8: 2.306, 9: 2.262, 10: 2.228,
+         11: 2.201, 12: 2.179, 13: 2.160, 14: 2.145, 15: 2.131,
+         16: 2.120, 17: 2.110, 18: 2.101, 19: 2.093, 20: 2.086,
+         21: 2.080, 22: 2.074, 23: 2.069, 24: 2.064, 25: 2.060,
+         26: 2.056, 27: 2.052, 28: 2.048, 29: 2.045, 30: 2.042}
+
+
+def paired_compare(records: List[Dict], mode_a: str, mode_b: str,
+                   network: str, budget: float = 0.02,
+                   seeds: List[int] = None) -> Dict:
+    """Paired-seed A/B inference over existing gauntlet records
+    (VERDICT r04 item 4).
+
+    Both arms train with COMMON random numbers (``run_one`` threads the
+    seed into init and data order), so per-seed mAP deltas cancel the
+    seed-to-seed variance that makes the absolute spread gate blunt: the
+    measured 5-seed spread of tiny-on-hard is ~0.035, but paired deltas
+    of a truly neutral change sit well under 0.01 (round-4 ablation data,
+    docs/GAUNTLET.md).  Reports, over the seeds present in BOTH arms:
+
+    * per-seed deltas (mode_b − mode_a),
+    * mean delta with a 95% Student-t CI (df = n−1),
+    * a two-sided sign test p-value (zeros dropped),
+    * ``within_budget``: whether the CI lies inside ±``budget`` — the
+      equivalence gate (CI-inside-bounds, i.e. TOST-style, NOT a mere
+      failure-to-reject).
+    """
+    import math
+
+    a = {r["seed"]: r["mAP"] for r in records
+         if r["mode"] == mode_a and r["network"] == network}
+    b = {r["seed"]: r["mAP"] for r in records
+         if r["mode"] == mode_b and r["network"] == network}
+    common = set(a) & set(b)
+    if seeds is not None:
+        common &= set(seeds)
+    seeds = sorted(common)
+    if not seeds:
+        raise ValueError(
+            f"no common seeds between {mode_a!r} and {mode_b!r} "
+            f"for network {network!r}")
+    deltas = [round(b[s] - a[s], 4) for s in seeds]
+    n = len(deltas)
+    mean = float(np.mean(deltas))
+    if n >= 2:
+        sem = float(np.std(deltas, ddof=1)) / math.sqrt(n)
+        t = _T975.get(n - 1, _T975[30])
+        ci = (mean - t * sem, mean + t * sem)
+    else:
+        ci = None  # one seed proves nothing (and json has no Infinity)
+    pos = sum(d > 0 for d in deltas)
+    neg = sum(d < 0 for d in deltas)
+    m = pos + neg
+    # two-sided exact binomial sign test, p = P(#pos as or more extreme)
+    if m:
+        k = min(pos, neg)
+        tail = sum(math.comb(m, i) for i in range(k + 1)) / 2.0 ** m
+        sign_p = min(1.0, 2.0 * tail)
+    else:
+        sign_p = 1.0
+    return {
+        "compare": f"{mode_b}-vs-{mode_a}", "network": network,
+        "seeds": seeds, "deltas": deltas,
+        "mean_delta": round(mean, 4),
+        "ci95": [round(ci[0], 4), round(ci[1], 4)] if ci else None,
+        "sign_test_p": round(sign_p, 4),
+        "budget": budget,
+        "within_budget": bool(ci is not None and -budget <= ci[0]
+                              and ci[1] <= budget),
+    }
 
 
 def render_markdown(records: List[Dict], path: str) -> None:
@@ -173,7 +259,7 @@ def main(argv=None):
     p.add_argument("--network", default="tiny",
                    choices=["vgg", "resnet50", "resnet101", "tiny"])
     p.add_argument("--mode", default=["e2e"], nargs="+",
-                   choices=["e2e", "alternate", "prenms"])
+                   choices=_MODES)
     p.add_argument("--seeds", type=int, nargs="+", default=[0, 1, 2])
     p.add_argument("--epochs", type=int, default=30)
     p.add_argument("--lr", type=float, default=3e-3)
@@ -190,16 +276,61 @@ def main(argv=None):
                    help="also render all records into this markdown table")
     p.add_argument("--force", action="store_true",
                    help="re-run cells that already have records")
+    p.add_argument("--compare", nargs=2, metavar=("MODE_A", "MODE_B"),
+                   default=None,
+                   help="paired-seed A/B: run any missing cells for both "
+                        "modes over --seeds, then report per-seed deltas, "
+                        "95%% CI and sign test; exits 1 if the CI is not "
+                        "inside ±--budget")
+    p.add_argument("--budget", type=float, default=0.02,
+                   help="equivalence budget for --compare (CI must lie "
+                        "inside ±budget)")
     args = p.parse_args(argv)
+    if args.compare:
+        # argparse can't put choices= on a 2-tuple arg; validate here — an
+        # unknown mode would silently train the default e2e recipe under
+        # the wrong label and the A/B would "pass" comparing e2e to itself
+        for m in args.compare:
+            if m not in _MODES:
+                p.error(f"--compare mode {m!r} not one of {_MODES}")
+
+    # a compare run IS a run of its two arms (resumable like any other);
+    # --mode is ignored in that case
+    modes = list(args.compare) if args.compare else list(args.mode)
+
+    def recipe_match(r: Dict) -> bool:
+        # a record only satisfies this invocation if it was produced by
+        # the SAME recipe — otherwise a stale 30-epoch record would pair
+        # against a fresh 20-epoch arm and the deltas would measure the
+        # recipe difference, not the mode difference.  Missing keys (old
+        # records) count as matching for back-compat.
+        return (r.get("epochs", args.epochs) == args.epochs
+                and r.get("lr", args.lr) == args.lr
+                and r.get("lr_step", args.lr_step) == args.lr_step
+                and r.get("batch_images",
+                          args.batch_images) == args.batch_images
+                and (r["mode"] != "prenms"
+                     or r.get("prenms_n", args.prenms_n) == args.prenms_n))
 
     records = _load(args.out)
-    have = {_key(r) for r in records}
-    for mode in args.mode:
+    have = {_key(r) for r in records if recipe_match(r)}
+    have_other_recipe = {_key(r) for r in records
+                         if not recipe_match(r)} - have
+    for mode in modes:
         for seed in args.seeds:
             k = (mode, args.network, seed)
             if k in have and not args.force:
                 logger.info("skip existing %s", k)
                 continue
+            if k in have_other_recipe and not args.force:
+                # refuse rather than silently retrain-and-replace: the
+                # existing record (e.g. the committed 30-epoch baseline)
+                # would be destroyed by a quick smoke at other settings
+                p.error(
+                    f"{k} exists in {args.out} under a DIFFERENT recipe "
+                    "(epochs/lr/lr_step/batch_images/prenms_n mismatch); "
+                    "use a fresh --out for this recipe, or --force to "
+                    "overwrite")
             logger.info("=== gauntlet %s seed %d ===", mode, seed)
             rec = run_one(args, mode, seed)
             records = [r for r in records if _key(r) != k] + [rec]
@@ -211,7 +342,16 @@ def main(argv=None):
         print(json.dumps({"group": g, **v}))
     if args.markdown:
         render_markdown(records, args.markdown)
+    if args.compare:
+        cmp = paired_compare([r for r in records if recipe_match(r)],
+                             args.compare[0], args.compare[1],
+                             args.network, budget=args.budget,
+                             seeds=args.seeds)
+        print(json.dumps(cmp))
+        if not cmp["within_budget"]:
+            return 1
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

@@ -84,6 +84,9 @@ def main(argv=None) -> None:
     p.add_argument("--trace_summary", action="store_true",
                    help="parse the dumped trace (utils/xplane.py) and "
                         "print device time by named scope and op class")
+    p.add_argument("--prenms", type=int, default=None,
+                   help="override TRAIN rpn_pre_nms_top_n (the adopted "
+                        "recipe is 6000; the config ships the ref 12000)")
     args = p.parse_args(argv)
 
     import jax
@@ -101,6 +104,8 @@ def main(argv=None) -> None:
     N = args.iters
     cfg = generate_config(args.network, args.dataset)
     cfg = cfg.replace_in("train", batch_images=n)
+    if args.prenms is not None:
+        cfg = cfg.replace_in("train", rpn_pre_nms_top_n=args.prenms)
     model = build_model(cfg)
     tr = cfg.train
     key = jax.random.PRNGKey(0)

@@ -1,0 +1,142 @@
+#!/bin/bash
+# Round-5 follow-up battery (runs after script/chip_battery.sh):
+#   A. stage table at the ADOPTED recipe (pre-NMS 6000) with N=16 unrolled
+#      chains — the N=4 table was noise-dominated (±25 ms error bars from
+#      the ~101 ms tunnel RTT; it printed negative stage times).
+#   B. 12000-vs-6000 full-step A/B at the bench config (one process, so
+#      the compile cache is shared and only the recipe differs).
+#   C. VERDICT r05 item 3: multi-seed mAP neutrality of TRAIN pre-NMS
+#      6000 at PRODUCTION scale (608x1024 canvas, 21888 anchors) — 3
+#      paired seeds x 2 arms of resnet101 on synthetic_hard@608x1024,
+#      judged by tools/gauntlet.py paired_compare (the new CI gate).
+#   D. batch sweep 2/4/8 WITHOUT remat (battery 1 only measured
+#      batch4+remat, and remat measured strictly slower) — the MFU
+#      headroom record.
+set -uo pipefail
+cd /root/repo
+LOG=${CHIP_BATTERY2_LOG:-/tmp/chip_battery2.log}
+exec > >(tee -a "$LOG") 2>&1
+echo "=== chip battery 2 start $(date) ==="
+
+# SKIP_A=1 skips the stage tables (already captured in a previous run)
+if [ -z "${SKIP_A:-}" ]; then
+  echo "--- A. stage table N=16, adopted recipe (prenms 6000) ---"
+  timeout 3000 python -m mx_rcnn_tpu.tools.profile_step --network resnet101 \
+    --iters 16 --prenms 6000
+  echo "--- A2. stage table N=16, ref recipe (prenms 12000) ---"
+  timeout 3000 python -m mx_rcnn_tpu.tools.profile_step --network resnet101 \
+    --iters 16 --prenms 12000
+fi
+
+echo "--- B. full-step 12000 vs 6000 (shared process) ---"
+timeout 1800 python - <<'EOF'
+import time
+import numpy as np
+import jax
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.core.train import make_train_step, setup_training
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.tools.profile_step import make_batch
+
+def fetch(x): return np.asarray(x).ravel()[:1]
+
+for prenms in (12000, 6000):
+    cfg = generate_config("resnet101", "coco",
+                          train__rpn_pre_nms_top_n=prenms,
+                          train__batch_images=2)
+    model = build_model(cfg)
+    batch = make_batch(cfg, 2, 608, 1024, raw=True)
+    key = jax.random.PRNGKey(0)
+    state, tx = setup_training(model, cfg, key, (2, 608, 1024, 3),
+                               steps_per_epoch=10_000)
+    step = jax.jit(make_train_step(model, cfg, tx), donate_argnums=(0,))
+    state, m = step(state, batch, key); fetch(m["loss"])
+    for _ in range(2): state, m = step(state, batch, key)
+    fetch(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(30): state, m = step(state, batch, key)
+    fetch(m["loss"])
+    dt = (time.perf_counter() - t0 - 0.1) / 30
+    print(f"A/B prenms={prenms}: {dt*1e3:.2f} ms/step  {2/dt:.1f} imgs/s",
+          flush=True)
+EOF
+
+echo "--- C. pre-NMS 6000 neutrality, 3 paired seeds @ 608x1024 ---"
+timeout 7200 python - <<'EOF'
+import json
+import logging; logging.basicConfig(level=logging.WARNING)
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.tools.train import train_net
+from mx_rcnn_tpu.tools.test import test_rcnn as eval_rcnn
+from mx_rcnn_tpu.tools.gauntlet import paired_compare
+
+# production-scale canvas: same 608x1024 bucket, stride-16 anchor grid
+# (21888 anchors) and (8,16,32) anchor scales as the BASELINE config; the
+# dataset's log-uniform object sizes (canvas/12..canvas/2 = 85..512 px)
+# land inside the production anchor range, so the proposal stage operates
+# in its production regime — unlike the 240x320 gauntlet canvas whose
+# 2700 anchors made every cap >= 2700 vacuous (docs/ROUND4.md §3)
+KW = dict(image_size=(608, 1024))
+records = []
+for seed in (0, 1, 2):
+    for mode, prenms in (("e2e", 12000), ("prenms", 6000)):
+        cfg = generate_config(
+            "resnet101", "synthetic_hard",
+            dataset__root_path="/tmp/neut608",
+            dataset__dataset_path="/tmp/neut608/synthetic_hard",
+            train__rpn_pre_nms_top_n=prenms,
+            train__batch_images=2)
+        prefix = f"/tmp/neut608/m-{prenms}-s{seed}"
+        train_net(cfg, prefix=prefix, end_epoch=10, lr=3e-3, lr_step="8",
+                  frequent=100000, seed=seed, dataset_kw=KW,
+                  device_cache=True)
+        r = eval_rcnn(cfg, prefix=prefix, epoch=10, verbose=False,
+                      dataset_kw=KW)
+        rec = {"mode": mode, "network": "resnet101", "seed": seed,
+               "mAP": round(float(r["mAP"]), 4)}
+        records.append(rec)
+        print(f"NEUT608 {mode} prenms={prenms} seed={seed}: "
+              f"mAP {rec['mAP']:.4f}", flush=True)
+        with open("/tmp/neut608/records.json", "w") as f:
+            json.dump(records, f)
+cmp = paired_compare(records, "e2e", "prenms", "resnet101", budget=0.02)
+print("NEUT608 paired:", json.dumps(cmp), flush=True)
+EOF
+
+echo "--- D. batch sweep 2/4/8 plain (no remat), adopted recipe ---"
+timeout 2400 python - <<'EOF'
+import time
+import numpy as np
+import jax
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.core.train import make_train_step, setup_training
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.tools.profile_step import make_batch
+
+def fetch(x): return np.asarray(x).ravel()[:1]
+
+for n in (2, 4, 8):
+    try:
+        cfg = generate_config("resnet101", "coco",
+                              train__rpn_pre_nms_top_n=6000,
+                              train__batch_images=n)
+        model = build_model(cfg)
+        batch = make_batch(cfg, n, 608, 1024, raw=True)
+        key = jax.random.PRNGKey(0)
+        state, tx = setup_training(model, cfg, key, (n, 608, 1024, 3),
+                                   steps_per_epoch=10_000)
+        step = jax.jit(make_train_step(model, cfg, tx), donate_argnums=(0,))
+        state, m = step(state, batch, key); fetch(m["loss"])
+        for _ in range(2): state, m = step(state, batch, key)
+        fetch(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(30): state, m = step(state, batch, key)
+        fetch(m["loss"])
+        dt = (time.perf_counter() - t0 - 0.1) / 30
+        print(f"SWEEP batch={n}: {dt*1e3:.2f} ms/step  {n/dt:.1f} imgs/s",
+              flush=True)
+    except Exception as e:
+        print(f"SWEEP batch={n}: FAILED {type(e).__name__} {e}", flush=True)
+EOF
+
+echo "=== chip battery 2 done $(date) ==="

@@ -191,3 +191,114 @@ def test_easy_dataset_unchanged(tmp_path):
     sig = ds._spec_signature()
     ds2 = SyntheticDataset("train", str(tmp_path), "", num_images=4)
     assert ds2._spec_signature() == sig
+
+
+# ---------------------------------------------------------------------------
+# Paired-seed A/B compare (VERDICT r04 item 4): the contract of
+# tools/gauntlet.py --compare.  Pure-function + CLI level; no training.
+# ---------------------------------------------------------------------------
+
+def _recs(mode, maps, network="tiny"):
+    return [{"mode": mode, "network": network, "seed": s, "mAP": m}
+            for s, m in enumerate(maps)]
+
+
+def test_paired_compare_neutral_change_passes_tight_budget():
+    from mx_rcnn_tpu.tools.gauntlet import paired_compare
+
+    base = [0.7648, 0.7448, 0.7638, 0.7332, 0.7517]  # committed e2e table
+    arm = [m + d for m, d in zip(base, [0.002, -0.003, 0.001, 0.0, -0.002])]
+    cmp = paired_compare(_recs("e2e", base) + _recs("prenms", arm),
+                         "e2e", "prenms", "tiny", budget=0.02)
+    assert cmp["seeds"] == [0, 1, 2, 3, 4]
+    assert cmp["deltas"] == [0.002, -0.003, 0.001, 0.0, -0.002]
+    assert cmp["within_budget"] is True
+    lo, hi = cmp["ci95"]
+    assert -0.02 <= lo <= cmp["mean_delta"] <= hi <= 0.02
+    # the absolute-spread gate could NEVER see this: the seed spread of
+    # the base arm alone (0.0316) dwarfs every per-seed delta
+    assert max(base) - min(base) > max(abs(d) for d in cmp["deltas"])
+
+
+def test_paired_compare_small_regression_caught():
+    """A uniform −0.015 regression is invisible to the ±0.035-spread
+    absolute gate but must fail the paired budget: with the seed noise
+    cancelled, the CI sits tightly around −0.015 and pokes out of ±0.01;
+    the sign test agrees (5/5 negative, p = 0.0625)."""
+    from mx_rcnn_tpu.tools.gauntlet import paired_compare
+
+    base = [0.7648, 0.7448, 0.7638, 0.7332, 0.7517]
+    jitter = [0.001, -0.002, 0.002, -0.001, 0.0]
+    arm = [m - 0.015 + j for m, j in zip(base, jitter)]
+    cmp = paired_compare(_recs("e2e", base) + _recs("prenms", arm),
+                         "e2e", "prenms", "tiny", budget=0.01)
+    assert cmp["within_budget"] is False
+    assert cmp["mean_delta"] < -0.01
+    assert cmp["sign_test_p"] == 0.0625  # 2 * 0.5**5
+    # but it IS equivalent under a generous 0.05 budget
+    loose = paired_compare(_recs("e2e", base) + _recs("prenms", arm),
+                           "e2e", "prenms", "tiny", budget=0.05)
+    assert loose["within_budget"] is True
+
+
+def test_paired_compare_single_seed_proves_nothing():
+    from mx_rcnn_tpu.tools.gauntlet import paired_compare
+
+    cmp = paired_compare(_recs("e2e", [0.75]) + _recs("prenms", [0.75]),
+                         "e2e", "prenms", "tiny")
+    assert cmp["within_budget"] is False  # infinite CI: no evidence
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="no common seeds"):
+        paired_compare(_recs("e2e", [0.75]), "e2e", "prenms", "tiny")
+
+
+def test_compare_cli_reuses_records_and_gates(tmp_path, capsys):
+    """--compare over an --out file whose cells all exist must not train:
+    it reports the paired stats and exits by the budget gate."""
+    from mx_rcnn_tpu.tools.gauntlet import main as gauntlet_main
+
+    base = [0.7648, 0.7448, 0.7638]
+    out = tmp_path / "results.json"
+    with open(out, "w") as f:
+        json.dump(_recs("e2e", base)
+                  + _recs("prenms", [m + 0.001 for m in base]), f)
+    rc = gauntlet_main(["--out", str(out), "--root", str(tmp_path),
+                        "--workdir", str(tmp_path / "w"),
+                        "--seeds", "0", "1", "2",
+                        "--compare", "e2e", "prenms"])
+    assert rc == 0
+    lines = [json.loads(l) for l in
+             capsys.readouterr().out.strip().splitlines()]
+    cmp = [l for l in lines if "compare" in l]
+    assert cmp and cmp[0]["compare"] == "prenms-vs-e2e"
+    assert cmp[0]["deltas"] == [0.001, 0.001, 0.001]
+    # a clear regression in one arm flips the exit code
+    with open(out, "w") as f:
+        json.dump(_recs("e2e", base)
+                  + _recs("prenms", [m - 0.04 for m in base]), f)
+    rc = gauntlet_main(["--out", str(out), "--root", str(tmp_path),
+                        "--workdir", str(tmp_path / "w"),
+                        "--seeds", "0", "1", "2",
+                        "--compare", "e2e", "prenms"])
+    assert rc == 1
+
+
+def test_compare_cli_refuses_recipe_mismatch(tmp_path, capsys):
+    """Existing records under a different recipe must ERROR (protecting
+    committed baselines from silent retrain-and-replace), not retrain."""
+    from mx_rcnn_tpu.tools.gauntlet import main as gauntlet_main
+
+    out = tmp_path / "results.json"
+    recs = _recs("e2e", [0.7, 0.71, 0.72])
+    for r in recs:
+        r["epochs"] = 30  # committed baseline recipe
+    with open(out, "w") as f:
+        json.dump(recs, f)
+    with pytest.raises(SystemExit) as ex:
+        gauntlet_main(["--out", str(out), "--root", str(tmp_path),
+                       "--workdir", str(tmp_path / "w"),
+                       "--seeds", "0", "--mode", "e2e", "--epochs", "2"])
+    assert ex.value.code == 2  # argparse error exit
+    assert "DIFFERENT recipe" in capsys.readouterr().err
+    with open(out) as f:  # baseline untouched
+        assert json.load(f) == recs

@@ -27,3 +27,6 @@ def test_dryrun_multichip_16_devices_hierarchical():
     assert "dryrun_multichip(16): OK — step executed" in out.stdout
     assert "(dcn=2, ici=8) hierarchical step matches" in out.stdout
     assert "(dcn=4, ici=4) hierarchical step matches" in out.stdout
+    # the inference certification line (VERDICT r04 item 5): eval forward,
+    # postprocess, RPN-only and RCNN-only all sharded over the same mesh
+    assert "eval sharded over the mesh" in out.stdout

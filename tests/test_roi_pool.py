@@ -1,7 +1,9 @@
 """ROIAlign / ROIPool tests vs small hand-checkable feature maps."""
 
-import numpy as np
+import jax
 import jax.numpy as jnp
+import numpy as np
+import pytest
 
 from mx_rcnn_tpu.ops.roi_pool import roi_align, roi_pool
 
@@ -85,3 +87,75 @@ def test_roi_align_bf16_close_to_fp32():
     # bf16 has ~2-3 significant decimal digits; interpolated activations are
     # O(1), so 3% absolute tolerance is ~4x the expected rounding noise
     np.testing.assert_allclose(out16, out32, atol=3e-2)
+
+
+# ---------------------------------------------------------------------------
+# Pallas fused ROIAlign (ops/roi_align_pallas.py): parity vs the einsum
+# oracle in interpreter mode (r5 — removes the HBM inter-matmul
+# intermediate measured at 5.84 ms of the 26.44 ms train step).
+# ---------------------------------------------------------------------------
+
+def _rand_rois(rng, n, r, h_img, w_img):
+    x1 = rng.uniform(0, w_img * 0.7, (n, r))
+    y1 = rng.uniform(0, h_img * 0.7, (n, r))
+    bw = rng.uniform(8, w_img * 0.4, (n, r))
+    bh = rng.uniform(8, h_img * 0.4, (n, r))
+    return np.stack([x1, y1, x1 + bw, y1 + bh], axis=-1).astype(np.float32)
+
+
+def test_roi_align_pallas_forward_matches_einsum():
+    from mx_rcnn_tpu.ops.roi_align_pallas import roi_align_pallas
+    from mx_rcnn_tpu.ops.roi_pool import roi_align
+
+    rng = np.random.RandomState(0)
+    n, h, w, c, r = 2, 19, 32, 64, 12  # r NOT a multiple of RB=8: pad path
+    feat = rng.randn(n, h, w, c).astype(np.float32)
+    rois = _rand_rois(rng, n, r, h * 16, w * 16)
+    want = jax.vmap(lambda f, b: roi_align(f, b, (7, 7), 1 / 16.0))(
+        jnp.asarray(feat), jnp.asarray(rois))
+    got = roi_align_pallas(jnp.asarray(feat), jnp.asarray(rois), (7, 7),
+                           1 / 16.0, 2, True)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_roi_align_pallas_grad_matches_einsum():
+    """d(pooled)/d(features) must match the einsum path's autodiff — the
+    custom VJP re-derives the transposed contractions by hand."""
+    from mx_rcnn_tpu.ops.roi_align_pallas import roi_align_pallas
+    from mx_rcnn_tpu.ops.roi_pool import roi_align
+
+    rng = np.random.RandomState(1)
+    n, h, w, c, r = 2, 10, 16, 32, 8
+    feat = jnp.asarray(rng.randn(n, h, w, c).astype(np.float32))
+    rois = jnp.asarray(_rand_rois(rng, n, r, h * 16, w * 16))
+    cot = jnp.asarray(rng.randn(n, r, 7, 7, c).astype(np.float32))
+
+    def loss_ein(f):
+        p = jax.vmap(lambda fi, b: roi_align(fi, b, (7, 7), 1 / 16.0))(
+            f, rois)
+        return jnp.sum(p * cot)
+
+    def loss_pal(f):
+        p = roi_align_pallas(f, rois, (7, 7), 1 / 16.0, 2, True)
+        return jnp.sum(p * cot)
+
+    g_ein = jax.grad(loss_ein)(feat)
+    g_pal = jax.grad(loss_pal)(feat)
+    np.testing.assert_allclose(np.asarray(g_pal), np.asarray(g_ein),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_roi_align_batched_dispatch():
+    """backend='jnp' and 'pallas' (interpret via CPU default resolve →
+    jnp; explicit pallas exercised above) agree; unknown backend raises."""
+    from mx_rcnn_tpu.ops.roi_pool import roi_align_batched
+
+    rng = np.random.RandomState(2)
+    feat = jnp.asarray(rng.randn(1, 8, 8, 16).astype(np.float32))
+    rois = jnp.asarray(_rand_rois(rng, 1, 4, 128, 128))
+    out = roi_align_batched(feat, rois, (7, 7), 1 / 16.0)
+    assert out.shape == (1, 4, 7, 7, 16)
+    with pytest.raises(ValueError, match="unknown roi_align backend"):
+        roi_align_batched(feat, rois, backend="cuda")

@@ -322,3 +322,54 @@ def test_predictor_sharded_matches_single_device():
                           ("rois", "valid", "cls_prob", "deltas")):
         assert a.shape == b.shape, name
         np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5, err_msg=name)
+
+
+def test_predictor_rpn_sharded_matches_single_device():
+    """The RPN-only proposal forward (Predictor.rpn, backing
+    generate_proposals) must be mesh-invariant like the full eval
+    forward, including the pad-and-trim path (5 images, 8 devices)."""
+    from mx_rcnn_tpu.parallel.dp import device_mesh
+
+    cfg = _toy_cfg()
+    model = build_model(cfg)
+    rng = np.random.RandomState(3)
+    n = 5
+    images = rng.randn(n, 128, 160, 3).astype(np.float32)
+    im_info = np.tile(np.array([[128.0, 160.0, 1.0]], np.float32), (n, 1))
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                    jnp.asarray(images[:1]),
+                                    jnp.asarray(im_info[:1]))
+    single = Predictor(model, variables, cfg)
+    sharded = Predictor(model, variables, cfg, mesh=device_mesh(8))
+    outs_s = single.rpn(images, im_info)
+    outs_m = sharded.rpn(images, im_info)
+    for a, b, name in zip(outs_s, outs_m, ("rois", "scores", "valid")):
+        a, b = np.asarray(a), np.asarray(b)
+        assert a.shape == b.shape, name
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5, err_msg=name)
+
+
+def test_generate_proposals_mesh_matches_host(tmp_path):
+    """generate_proposals(mesh=...) — multi-chip proposal dump for the
+    alternate schedule — must return the single-device proposals."""
+    from mx_rcnn_tpu.data import load_gt_roidb
+    from mx_rcnn_tpu.parallel.dp import device_mesh
+
+    cfg = _toy_cfg(num_classes=4)
+    cfg = cfg.replace_in(
+        "dataset", root_path=str(tmp_path),
+        dataset_path=str(tmp_path / "synthetic"))
+    kw = dict(num_images=3, image_size=(128, 160), max_objects=2)
+    imdb, roidb = load_gt_roidb(cfg, training=False, **kw)
+    model = build_model(cfg)
+    loader = TestLoader(roidb, cfg)
+    b = next(iter(loader))[0]
+    variables = jax.jit(model.init)(
+        jax.random.PRNGKey(0), jnp.asarray(b.images),
+        jnp.asarray(b.im_info))
+    base = generate_proposals(model, variables, TestLoader(roidb, cfg), cfg)
+    mesh = generate_proposals(model, variables, TestLoader(roidb, cfg), cfg,
+                              mesh=device_mesh(8))
+    assert len(base) == len(mesh)
+    for p0, p1 in zip(base, mesh):
+        np.testing.assert_allclose(p0, p1, atol=1e-5, rtol=1e-5)
