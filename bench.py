@@ -56,13 +56,13 @@ import numpy as np
 # Last independently verified numbers, reported (with provenance) only on
 # the degraded path when no live measurement could be captured.
 _LAST_VERIFIED = {
-    "value": 74.8,              # BENCH_r02.json — driver-captured
-    "sustained": 72.7,          # docs/PERF.md r3 in-session (device-rate)
-    "source": ("last verified: BENCH_r02 driver capture (74.8 imgs/s); "
-               "sustained from docs/PERF.md round-3 in-session run; both "
-               "measured at TRAIN pre-NMS 12000 — the bench now runs the "
-               "adopted 6000 recipe (~16% faster), so a live number is "
-               "expected HIGHER than these"),
+    "value": 76.9,              # r5 chip_battery live capture, 2026-07-31
+    "sustained": 76.7,          # same run (HBM epoch cache, 1.00x device)
+    "source": ("last verified: round-5 chip_battery live capture "
+               "(76.9 imgs/s headline / 76.7 sustained, adopted pre-NMS "
+               "6000 recipe); post-capture in-session bests reached "
+               "79-81 imgs/s after the r5 anchor-subsample fix "
+               "(docs/PERF.md round-5 section)"),
 }
 
 
