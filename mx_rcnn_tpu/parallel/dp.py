@@ -131,11 +131,14 @@ def make_dp_cached_step(model: FasterRCNN, cfg: Config, tx, mesh: Mesh,
     ``(replicated state, sharded epoch data, replicated idx, replicated
     key) -> (state, idx+1, metrics)`` — but runs under ``shard_map``: each
     device gathers ITS slice of the selected batch from its local shard
-    (the epoch is laid out ``P(None, data_axes)``, so the batch-index
-    gather on axis 0 is shard-local), RNG decorrelates per mesh position,
-    and gradients pmean over all mesh axes inside the step.  The epoch
-    permutation draws from the replicated key, so every device picks the
-    same batch index.
+    (the epoch is laid out ``P(None, data_axes)``, so the image-granular
+    gather stays shard-local — inside shard_map the leaves carry LOCAL
+    shapes and the per-epoch regroup permutes each device's own images),
+    RNG decorrelates per mesh position, and gradients pmean over all mesh
+    axes inside the step.  The epoch permutation draws from the
+    replicated key, so devices stay in lockstep.  Disclosed residual vs
+    streaming DP: images never migrate across devices between epochs
+    (data/device_cache.py module docstring).
     """
     from mx_rcnn_tpu.data.device_cache import make_cached_step
 

@@ -25,6 +25,24 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the slow tier is compile-dominated
+# (training-loop tests re-jit the same tiny programs every run), so a
+# warm cache cuts repeat `make test-all` wall-clock several-fold — the
+# re-runnability VERDICT r04 item 8 asks for.  Safe to share across runs:
+# entries key on the full HLO + flags; delete the dir to force cold.
+_cache_dir = os.environ.get("MXRCNN_TEST_JAX_CACHE",
+                            "/tmp/mxrcnn_jax_test_cache")
+try:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # subprocess tests (multihost rigs, stage CLIs, supervisor children)
+    # start fresh interpreters that never read this conftest — the env
+    # var routes them to the same cache
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
+except Exception:  # older jax without the knobs: cold compiles only
+    pass
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -53,3 +71,10 @@ def pytest_configure(config):
         "markers",
         "slow: training-loop / subprocess / e2e tests excluded from the "
         "quick tier (run with `make test-all` or `-m slow`)")
+    config.addinivalue_line(
+        "markers",
+        "gate: the two multi-minute end-metric gates (30-epoch gauntlet "
+        "seed-0 train-from-scratch, 16-device hierarchical dryrun) — "
+        "excluded from `make test-all` so the full tier stays "
+        "independently re-runnable in ~15 min on one core; run with "
+        "`make test-gate`")

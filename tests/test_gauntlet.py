@@ -166,6 +166,7 @@ def test_recorded_gauntlet_results_within_budget():
 
 
 @pytest.mark.slow
+@pytest.mark.gate
 def test_gauntlet_pinned_seed0_regression_gate(tmp_path):
     """Train seed 0 with the production gauntlet recipe from scratch and
     assert the mAP floor (see module docstring for why a one-sided floor

@@ -15,6 +15,7 @@ import pytest
 
 
 @pytest.mark.slow
+@pytest.mark.gate
 def test_dryrun_multichip_16_devices_hierarchical():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
