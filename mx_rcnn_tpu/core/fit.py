@@ -110,14 +110,16 @@ def fit(
     and returns — the preemption path (SIGTERM on preemptible TPUs).
     ``device_cache``: stage the loader's epoch in HBM once and gather each
     step's batch on device (``data/device_cache.py``) — for RAM/HBM-scale
-    datasets on hosts or links too slow to stream per step.  Semantics
-    deviation (disclosed): batch COMPOSITION is frozen at staging; epochs
-    reshuffle batch ORDER on device (deterministically from ``key`` and
-    the epoch number, so resume stays step-exact; with ``shuffle=False``
-    loaders the run is bit-identical to streaming).  Composes with a mesh:
-    the epoch shards over the data axes and every device gathers its slice
-    of each batch (``parallel.dp.make_dp_cached_step``).  Limit: requires
-    a single-bucket dataset.
+    datasets on hosts or links too slow to stream per step.  Shuffling is
+    IMAGE-granular (r5): each epoch re-groups images into new batches via
+    an on-device permutation, matching the streaming loader's in-bucket
+    semantics (deterministic from ``key`` and the epoch number, so resume
+    stays step-exact; ``shuffle=False`` loaders run bit-identical to
+    streaming).  Composes with a mesh: the epoch shards over the data
+    axes and each device regroups within its own shard (disclosed
+    residual: images don't migrate across devices between epochs —
+    ``parallel.dp.make_dp_cached_step``).  Limit: requires a
+    single-bucket dataset.
     Mid-epoch RESUME is driven by ``state.step`` alone: if the incoming
     state is ``skip`` steps past ``begin_epoch``'s start, the first epoch
     skips its first ``skip`` batches; the deterministic per-epoch shuffle

@@ -101,6 +101,13 @@ def launch(n: int, steps: int, local_devices: int = 2) -> int:
     procs = []
     outs = []
     ok = True
+    # no shared compilation cache for the workers: with jax.distributed,
+    # ranks that HIT the cache race ahead of ranks that compile, and the
+    # collective-init barrier can time the stragglers out (reproduced
+    # when the test conftest exported JAX_COMPILATION_CACHE_DIR to
+    # subprocesses — every rank compiles, or none)
+    env = {k: v for k, v in os.environ.items()
+           if k != "JAX_COMPILATION_CACHE_DIR"}
     try:
         for i in range(n):
             procs.append(subprocess.Popen(
@@ -110,7 +117,7 @@ def launch(n: int, steps: int, local_devices: int = 2) -> int:
                  "--coordinator", f"localhost:{port}",
                  "--steps", str(steps)],
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True))
+                text=True, env=env))
         for p in procs:
             out, _ = p.communicate(timeout=900)
             outs.append(out)

@@ -36,10 +36,17 @@ try:
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    # subprocess tests (multihost rigs, stage CLIs, supervisor children)
+    # subprocess tests (stage CLIs, supervisor children, graft dryruns)
     # start fresh interpreters that never read this conftest — the env
-    # var routes them to the same cache
+    # vars route them to the same cache WITH the same thresholds (the
+    # dir alone would leave children at jax's 1.0 s min-compile-time
+    # default and skip exactly the tiny programs this suite compiles).
+    # Exception: the jax.distributed multihost workers strip the cache
+    # dir (tools/multihost_demo.py — cache-hit ranks racing compile-miss
+    # ranks deadlocked the collective-init barrier).
     os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
+    os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0.5"
+    os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "0"
 except Exception:  # older jax without the knobs: cold compiles only
     pass
 
