@@ -5,10 +5,15 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-# TRAIN pre-NMS 6000 (not the ref's 12000): measured mAP-neutral on this
-# stack and ~16% faster per step (docs/PERF.md round 3) — adopted as the
-# recipe default; pass --set train__rpn_pre_nms_top_n=12000 for strict
-# reference parity.
+# TRAIN pre-NMS 6000 (not the ref's 12000): measured 9% faster per step
+# paired in one process, and mAP-neutral to the bit — 3 paired seeds at
+# the production 608x1024 canvas all scored identical mAP in both arms
+# (docs/PERF.md round 5, docs/neut608_records.json).  Pass
+# --set train__rpn_pre_nms_top_n=12000 for strict reference parity.
+#
+# Throughput-optimal secondary config (r5 batch sweep): per-chip batch 8
+# measured 92.7 imgs/s vs 79.5 at the contract batch 2 — use
+# --batch_images 8 when fewer, larger gradient steps are acceptable.
 python -m mx_rcnn_tpu.tools.train \
   --network resnet101 --dataset coco \
   --prefix model/resnet_coco_e2e --end_epoch 8 --lr 0.001 --lr_step 6 \
