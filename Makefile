@@ -13,7 +13,7 @@ NATIVE_DIR := mx_rcnn_tpu/native
 NATIVE_LIB := $(NATIVE_DIR)/libmxrcnn_native.so
 NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 
-.PHONY: all native test test-all test-gate clean
+.PHONY: all native lint test test-all test-gate clean
 
 all: native
 
@@ -21,6 +21,12 @@ native: $(NATIVE_LIB)
 
 $(NATIVE_LIB): $(NATIVE_SRC)
 	$(CXX) $(CXXFLAGS) -o $@ $(NATIVE_SRC)
+
+# TPU-graph hygiene static analysis (docs/ANALYSIS.md): fails on any
+# unwaived finding — the compile-time half of the recompile/leak guard
+# (tests/test_recompile_guard.py is the runtime half)
+lint:
+	python -m mx_rcnn_tpu.analysis.graphlint mx_rcnn_tpu
 
 # quick tier: unit + fast integration — measured ~6 min idle / 12 min
 # contended on this 1-core box (r5: 211 tests)
@@ -37,8 +43,10 @@ test-all:
 
 # the two end-metric gates (30-epoch gauntlet seed-0 from scratch
 # ~22 min, 16-device hierarchical dryrun ~7 min on one core) — run
-# these for round-gate evidence; test-all stays green without them
-test-gate:
+# these for round-gate evidence; test-all stays green without them.
+# graphlint runs first: a hygiene violation fails the gate in seconds
+# instead of after 30 minutes of training
+test-gate: lint
 	python -m pytest tests/ -x -q -m "gate"
 
 clean:

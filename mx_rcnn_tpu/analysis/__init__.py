@@ -1,0 +1,15 @@
+"""Static analysis for TPU-graph hygiene.
+
+The repo's core performance invariant (PAPER.md, docs/DESIGN.md) is that
+the hot path is ONE XLA program with static shapes — no host syncs, no
+per-step recompiles.  ``graphlint`` makes that invariant machine-checked:
+``python -m mx_rcnn_tpu.analysis.graphlint mx_rcnn_tpu`` (also ``make
+lint``) walks the graph-scope packages and reports violations by rule
+code.  The runtime counterpart lives in ``tests/test_recompile_guard.py``
+(jit cache-miss budget + tracer-leak checks).  Rule catalogue and waiver
+syntax: docs/ANALYSIS.md.
+
+Import ``RULES`` / ``lint_paths`` from ``mx_rcnn_tpu.analysis.graphlint``
+directly (kept out of this namespace so ``python -m`` does not double-load
+the module).
+"""

@@ -205,7 +205,7 @@ def _backbone_features(model: FasterRCNN, variables, batch, cfg: Config):
     return f(variables, batch.images)
 
 
-def loss_and_metrics(
+def loss_and_metrics(  # graphlint: jit (traced via LOSS_FNS inside the step)
     model: FasterRCNN,
     params,
     batch_stats,
@@ -261,7 +261,7 @@ def loss_and_metrics(
     return total, metrics
 
 
-def loss_and_metrics_rpn(
+def loss_and_metrics_rpn(  # graphlint: jit (traced via LOSS_FNS)
     model: FasterRCNN,
     params,
     batch_stats,
@@ -283,7 +283,7 @@ def loss_and_metrics_rpn(
     return total, {**metrics, "loss": total}
 
 
-def loss_and_metrics_rcnn(
+def loss_and_metrics_rcnn(  # graphlint: jit (traced via LOSS_FNS)
     model: FasterRCNN,
     params,
     batch_stats,
@@ -338,8 +338,9 @@ def init_variables(
         }
         return flax.core.freeze(params).unfreeze(), batch_stats
 
-    # one compiled program instead of thousands of tunneled eager ops
-    return jax.jit(_init)(key)
+    # one compiled program instead of thousands of tunneled eager ops;
+    # init runs once per process so discarding the jit cache is the point
+    return jax.jit(_init)(key)  # graphlint: disable=GL302 one-shot init program
 
 
 def init_state(
@@ -398,6 +399,7 @@ def make_train_step(model: FasterRCNN, cfg: Config,
     """
     loss_and_metrics_fn = LOSS_FNS[mode]
 
+    # graphlint: jit (jitted by fit/parallel.dp after construction)
     def step(state: TrainState, batch, key: jax.Array
              ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
         key = jax.random.fold_in(key, state.step)

@@ -57,6 +57,15 @@ def decode_pool_from_config(cfg: Config):
     # is never consulted on this path — advisor r4).  The disk tier stays
     # shared via cache_dir.
     per_worker = (d.image_cache_mb << 20) // d.decode_procs
+    if d.image_cache_mb > 0 and per_worker < (1 << 20):
+        # an integer-division share of 0 would silently disable the RAM
+        # tier the config asked for (ADVICE r5); clamp to a useful floor
+        logging.getLogger("mx_rcnn_tpu").warning(
+            "image_cache_mb=%d split across decode_procs=%d leaves under "
+            "1 MB per worker; clamping each worker's RAM tier to 1 MB "
+            "(raise image_cache_mb to at least decode_procs to silence)",
+            d.image_cache_mb, d.decode_procs)
+        per_worker = 1 << 20
     if d.image_cache_mb > 0:
         logging.getLogger("mx_rcnn_tpu").info(
             "decode_procs=%d: image_cache_mb=%d RAM tier moves into the "

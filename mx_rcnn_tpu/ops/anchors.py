@@ -73,9 +73,11 @@ def generate_anchors(
     the [0, 0, 15, 15] window), e.g. the canonical first anchor for the
     defaults is ``[-84, -40, 99, 55]``.
     """
-    ratios = np.asarray(ratios, dtype=np.float64)
-    scales = np.asarray(scales, dtype=np.float64)
-    base_anchor = np.array([1, 1, base_size, base_size], dtype=np.float64) - 1
+    # fp64 on purpose: host-side trace-time constants matching the
+    # reference's Cython anchor enumeration bit-for-bit; cast to fp32 below
+    ratios = np.asarray(ratios, dtype=np.float64)  # graphlint: disable=GL401 reference-parity host constant
+    scales = np.asarray(scales, dtype=np.float64)  # graphlint: disable=GL401 reference-parity host constant
+    base_anchor = np.array([1, 1, base_size, base_size], dtype=np.float64) - 1  # graphlint: disable=GL401 reference-parity host constant
     ratio_anchors = _ratio_enum(base_anchor, ratios)
     anchors = np.vstack(
         [_scale_enum(ratio_anchors[i, :], scales) for i in range(ratio_anchors.shape[0])]

@@ -77,8 +77,10 @@ from mx_rcnn_tpu.ops.roi_pool import interp_matrices
 # raise the default 16 MiB scoped-VMEM cap: v5e has far more physical
 # VMEM, and the backward's value chain (g block, its transpose, RB fat-dot
 # results, da2, the fp32 accumulator) measured a 2x slowdown when Mosaic
-# spilled it under the default cap
-_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
+# spilled it under the default cap.  (``TPUCompilerParams`` is the 0.4.x
+# spelling of the same dataclass.)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+_COMPILER_PARAMS = _CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
 
 
 def _pick_blocks(r: int, c: int) -> Tuple[int, int]:
@@ -238,12 +240,13 @@ def _roi_align_fwd(features, rois, output_size, spatial_scale,
         compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(wy.reshape(n, r_pad * ph, h), features, wx)
-    return out[:, :r], (wy, wx, h, w, c)
+    # rois ride the residuals only to shape the zero cotangent in bwd
+    return out[:, :r], (wy, wx, rois, h, w, c)
 
 
 def _roi_align_bwd(output_size, spatial_scale, sampling_ratio, interpret,
                    res, g):
-    wy, wx, h, w, c = res
+    wy, wx, rois, h, w, c = res
     ph, pw = output_size
     n, r_pad = wy.shape[0], wy.shape[1]
     rb, cb = _pick_blocks(r_pad, c)
@@ -266,8 +269,11 @@ def _roi_align_bwd(output_size, spatial_scale, sampling_ratio, interpret,
         interpret=interpret,
     )(wy.reshape(n, r_pad * ph, h), wx, g)
     # no gradient to rois: proposal boxes are data (ref ROIPooling
-    # likewise propagates only to the feature map)
-    return dfeat, None
+    # likewise propagates only to the feature map) — but the cotangent is
+    # an explicit zeros array, not bare None: None-as-zero worked by
+    # accident of the pytree check and fails opaquely at trace time the
+    # moment anything differentiates w.r.t. rois (ADVICE r5)
+    return dfeat, jnp.zeros_like(rois)
 
 
 roi_align_pallas.defvjp(_roi_align_fwd, _roi_align_bwd)

@@ -48,24 +48,31 @@ def _choose_k(key: jax.Array, mask: jnp.ndarray, k_max: int,
     """Uniformly choose min(quota, count(mask)) True elements.
 
     The same selection SET as ``_rank_of_uniform(key, mask) < quota``
-    (identical uniforms, identical smallest-quota winners, almost surely),
-    but via ``top_k(k_max)`` instead of a full-array argsort: at the RPN's
+    (identical uniforms, identical smallest-quota winners), but via
+    ``top_k(k_max)`` instead of a full-array argsort: at the RPN's
     21 888 anchors the two argsorts were ~2.4 ms of the 26.4 ms train step
     (r5 N=16 stage table) for a 256-element draw.  ``k_max`` is static and
-    bounds the traced ``quota``; used where only the threshold test is
-    needed (anchor_target) — proposal_target keeps rank-of-uniform because
-    its priority keys consume the rank VALUES.
+    bounds the traced ``quota``; used where only membership is needed
+    (anchor_target) — proposal_target keeps rank-of-uniform because its
+    priority keys consume the rank VALUES.
+
+    Selection scatters True at the top_k *indices* with position < quota
+    rather than thresholding on values (``r <= small[quota-1]`` kept
+    quota+1 elements whenever two of the ~2^23 distinct fp32 uniforms
+    collided exactly at the threshold — expected a few times per 21 888-
+    anchor draw, ADVICE r5), so the count is exact even under ties.
     """
     # top_k demands k <= array size; toy grids (e.g. the 64x64 dryrun
     # canvas: 144 anchors) can be smaller than the 256-anchor RPN batch
     k_max = min(k_max, mask.shape[0])
     if k_max <= 0:
         return jnp.zeros_like(mask)
-    r = jax.random.uniform(key, mask.shape)
-    r = jnp.where(mask, r, _INF)
-    small = -jax.lax.top_k(-r, k_max)[0]  # ascending k_max smallest
-    thr = small[jnp.clip(quota - 1, 0, k_max - 1)]
-    return mask & (r <= thr) & (quota > 0)
+    r = jnp.where(mask, jax.random.uniform(key, mask.shape), _INF)
+    neg_small, idx = jax.lax.top_k(-r, k_max)  # k_max smallest of r
+    # position < quota wins; _INF sentinels (reached only when quota
+    # exceeds count(mask)) never win
+    take = (jnp.arange(k_max) < quota) & (-neg_small < _INF)
+    return jnp.zeros_like(mask).at[idx].set(take)
 
 
 def _rank_of_uniform(key: jax.Array, mask: jnp.ndarray) -> jnp.ndarray:

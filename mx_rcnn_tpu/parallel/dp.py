@@ -33,6 +33,23 @@ from mx_rcnn_tpu.core.train import Batch, TrainState, make_train_step
 from mx_rcnn_tpu.models.faster_rcnn import FasterRCNN
 
 
+def shard_map_compat(f, *, mesh: Mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions.
+
+    New jax exposes ``jax.shard_map`` with ``check_vma``; 0.4.x has
+    ``jax.experimental.shard_map.shard_map`` with ``check_rep``.  Both
+    checks are disabled for the same reason: the RNG fold_in of
+    ``axis_index`` is deliberately replica-varying.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=False)
+
+
 def device_mesh(n_devices: Optional[int] = None,
                 devices: Optional[Sequence[jax.Device]] = None,
                 dcn_size: int = 1) -> Mesh:
@@ -90,6 +107,7 @@ def _folded_step(model: FasterRCNN, cfg: Config, tx, axes, mode: str):
     of the mesh factorization."""
     base = make_train_step(model, cfg, tx, axis_name=axes, mode=mode)
 
+    # graphlint: jit (runs under shard_map built by the two factories below)
     def shard_fn(state: TrainState, batch: Batch, key: jax.Array):
         key = jax.random.fold_in(key, jax.lax.axis_index(axes))
         return base(state, batch, key)
@@ -110,12 +128,11 @@ def make_dp_train_step(model: FasterRCNN, cfg: Config, tx, mesh: Mesh,
     axes = data_axes(mesh)
     shard_fn = _folded_step(model, cfg, tx, axes, mode)
 
-    sharded = jax.shard_map(
+    sharded = shard_map_compat(
         shard_fn,
         mesh=mesh,
         in_specs=(P(), P(axes), P()),
         out_specs=(P(), P()),
-        check_vma=False,  # RNG fold_in of axis_index is deliberately varying
     )
     # donate the replicated state: in-place HBM update, no per-step copy
     return jax.jit(sharded, donate_argnums=(0,))
@@ -145,11 +162,10 @@ def make_dp_cached_step(model: FasterRCNN, cfg: Config, tx, mesh: Mesh,
     axes = data_axes(mesh)
     cached = make_cached_step(_folded_step(model, cfg, tx, axes, mode),
                               num_batches, shuffle=shuffle)
-    sharded = jax.shard_map(
+    sharded = shard_map_compat(
         cached,
         mesh=mesh,
         in_specs=(P(), P(None, axes), P(), P()),
         out_specs=(P(), P(), P()),
-        check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0, 2))

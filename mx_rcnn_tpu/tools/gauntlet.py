@@ -113,8 +113,23 @@ def _key(r: Dict):
     return (r["mode"], r["network"], r["seed"])
 
 
+def _recipe_str(r: Dict) -> str:
+    """Compact recipe tag for a record — shown in summaries so mixed-recipe
+    result files are visible instead of silently aggregated (ADVICE r5)."""
+    s = (f"ep{r.get('epochs', '?')}/lr{r.get('lr', '?')}"
+         f"/step{r.get('lr_step') or 'auto'}/bi{r.get('batch_images', '?')}")
+    if r.get("mode") == "prenms":
+        s += f"/pre{r.get('prenms_n', '?')}"
+    return s
+
+
 def summarize(records: List[Dict]) -> Dict[str, Dict]:
-    """Per (mode, network): seed mAPs, mean, spread (max-min)."""
+    """Per (mode, network): seed mAPs, mean, spread (max-min), recipes.
+
+    ``recipes`` lists every distinct recipe contributing to the group —
+    more than one entry means the stats mix training recipes and should
+    not be compared point-for-point.
+    """
     groups: Dict[str, List[Dict]] = {}
     for r in records:
         groups.setdefault(f"{r['mode']}/{r['network']}", []).append(r)
@@ -126,6 +141,7 @@ def summarize(records: List[Dict]) -> Dict[str, Dict]:
             "mAPs": maps,
             "mean": round(float(np.mean(maps)), 4),
             "spread": round(float(max(maps) - min(maps)), 4),
+            "recipes": sorted({_recipe_str(r) for r in rs}),
         }
     return out
 
@@ -217,14 +233,15 @@ def render_markdown(records: List[Dict], path: str) -> None:
         "(max−min over seeds) is the regression budget for any pinned",
         "end-metric expectations in the test suite.",
         "",
-        "| mode/network | seeds | mAP per seed | mean | spread |",
-        "|---|---|---|---|---|",
+        "| mode/network | seeds | mAP per seed | mean | spread | recipe |",
+        "|---|---|---|---|---|---|",
     ]
     for g, v in s.items():
         lines.append(
             f"| {g} | {v['seeds']} | "
             f"{', '.join(f'{m:.4f}' for m in v['mAPs'])} | "
-            f"{v['mean']:.4f} | {v['spread']:.4f} |")
+            f"{v['mean']:.4f} | {v['spread']:.4f} | "
+            f"{'; '.join(v['recipes'])} |")
     lines += [
         "",
         "Calibration history (round 4, in the open): the first recipe",
@@ -316,21 +333,27 @@ def main(argv=None):
     have = {_key(r) for r in records if recipe_match(r)}
     have_other_recipe = {_key(r) for r in records
                          if not recipe_match(r)} - have
+    # refuse rather than silently retrain-and-replace: the existing record
+    # (e.g. the committed 30-epoch baseline) would be destroyed by a quick
+    # smoke at other settings.  Validate EVERY requested cell up front —
+    # erroring mid-run used to abort an invocation after it had already
+    # trained several cells (ADVICE r5)
+    if not args.force:
+        stale = [k for mode in modes for seed in args.seeds
+                 if (k := (mode, args.network, seed)) in have_other_recipe
+                 and k not in have]
+        if stale:
+            p.error(
+                f"{stale} exist in {args.out} under a DIFFERENT recipe "
+                "(epochs/lr/lr_step/batch_images/prenms_n mismatch); "
+                "use a fresh --out for this recipe, or --force to "
+                "overwrite")
     for mode in modes:
         for seed in args.seeds:
             k = (mode, args.network, seed)
             if k in have and not args.force:
                 logger.info("skip existing %s", k)
                 continue
-            if k in have_other_recipe and not args.force:
-                # refuse rather than silently retrain-and-replace: the
-                # existing record (e.g. the committed 30-epoch baseline)
-                # would be destroyed by a quick smoke at other settings
-                p.error(
-                    f"{k} exists in {args.out} under a DIFFERENT recipe "
-                    "(epochs/lr/lr_step/batch_images/prenms_n mismatch); "
-                    "use a fresh --out for this recipe, or --force to "
-                    "overwrite")
             logger.info("=== gauntlet %s seed %d ===", mode, seed)
             rec = run_one(args, mode, seed)
             records = [r for r in records if _key(r) != k] + [rec]

@@ -1,0 +1,43 @@
+"""graphlint test fixture: one deliberate violation per rule.
+
+NEVER imported — ``tests/test_graphlint.py`` lints this file and pins the
+exact set of rule codes it must trip.  The directory is named ``ops/`` so
+the file counts as graph scope (the linter keys graph scope off path
+components).
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+_BAD_CONST = jnp.zeros((4,))  # GL402: module-level jnp constant
+
+_F64 = np.float64  # graphlint: disable=GL401
+# ^ waiver with NO reason: must itself be flagged (GL001)
+
+_ALSO_F64 = np.float64(3.0)  # graphlint: disable=GL999 bogus rule code
+# ^ waiver naming an unknown rule: GL002 (and the GL401 stays active)
+
+
+@functools.partial(jax.jit, static_argnames=("flags",))
+def jitted(x, flags=[1, 2]):  # GL303: mutable default on a static arg
+    n = np.sum(x)                     # GL101: host numpy on a traced value
+    v = float(x[0])                   # GL103: scalar coercion of a tracer
+    print(v)                          # GL104: host print in jit scope
+    nz = jnp.nonzero(x)               # GL201: dynamic output shape
+    w = jnp.where(x > 0)              # GL201: one-arg where is nonzero
+    y = x[x > 0]                      # GL202: boolean-mask indexing
+    if jnp.any(x > 0):                # GL203: Python `if` on a tracer
+        x = x + [1.0, 2.0]            # GL403: bare list literal arithmetic
+    z = x.item()                      # GL102: host materialization
+    u = x.astype(float)               # GL401: float64 promotion
+    return n, v, nz, w, y, z, u
+
+
+def build_and_call(xs):
+    for _ in range(2):
+        f = jax.jit(functools.partial(jitted))  # GL301 (+GL302: in a loop)
+    out = jax.jit(lambda a: a + 1)(xs)          # GL302: jit-and-call once
+    return f, out

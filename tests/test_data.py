@@ -517,3 +517,37 @@ def test_test_cli_consumes_set_overrides(tmp_path, monkeypatch):
     test_tool.main(["--network", "tiny", "--dataset", "synthetic",
                     "--epoch", "1", "--set", "test__score_thresh=0.25"])
     assert seen["thresh"] == 0.25
+
+
+def test_decode_pool_small_cache_budget_clamped(monkeypatch, caplog):
+    """image_cache_mb < decode_procs used to floor the per-worker RAM
+    share to 0, silently disabling the cache the config asked for
+    (ADVICE r5): now it clamps to 1 MB and says so."""
+    import logging
+
+    from mx_rcnn_tpu.data import loader as loader_mod
+
+    built = {}
+
+    class FakePool:
+        def __init__(self, procs, cache_dir=None, ram_bytes=None):
+            built.update(procs=procs, cache_dir=cache_dir,
+                         ram_bytes=ram_bytes)
+
+    monkeypatch.setattr("mx_rcnn_tpu.data.decode_pool.DecodePool", FakePool)
+    cfg = generate_config("tiny", "synthetic", default__decode_procs=8,
+                          default__image_cache_mb=4)
+    with caplog.at_level(logging.WARNING, logger="mx_rcnn_tpu"):
+        loader_mod.decode_pool_from_config(cfg)
+    assert built["ram_bytes"] == 1 << 20
+    assert "image_cache_mb=4" in caplog.text
+    assert "decode_procs=8" in caplog.text
+    # a healthy budget still splits undisturbed, without the warning
+    built.clear()
+    caplog.clear()
+    cfg = generate_config("tiny", "synthetic", default__decode_procs=4,
+                          default__image_cache_mb=12)
+    with caplog.at_level(logging.WARNING, logger="mx_rcnn_tpu"):
+        loader_mod.decode_pool_from_config(cfg)
+    assert built["ram_bytes"] == 3 << 20
+    assert "clamping" not in caplog.text

@@ -251,12 +251,13 @@ def test_dp_cached_shuffle_regroups_within_shards():
     def spy(state, batch, key):
         return state, {"tags": batch.gt_classes[:, 0]}
 
-    cstep = jax.jit(jax.shard_map(
+    from mx_rcnn_tpu.parallel.dp import shard_map_compat
+
+    cstep = jax.jit(shard_map_compat(
         make_cached_step(spy, nb, shuffle=True),
         mesh=mesh,
         in_specs=(P(), P(None, axes), P(), P()),
         out_specs=(P(), P(), P(axes)),  # concat per-device tags
-        check_vma=False,
     ))
     bi_local = bi_global // mesh.size
     shard_of = {}  # device -> its staged image ids

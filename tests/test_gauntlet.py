@@ -303,3 +303,52 @@ def test_compare_cli_refuses_recipe_mismatch(tmp_path, capsys):
     assert "DIFFERENT recipe" in capsys.readouterr().err
     with open(out) as f:  # baseline untouched
         assert json.load(f) == recs
+
+
+def test_gauntlet_prevalidates_all_cells_before_training(tmp_path, capsys,
+                                                         monkeypatch):
+    """Recipe-mismatch validation must cover every requested (mode, seed)
+    cell BEFORE the first training run (ADVICE r5): the guard used to fire
+    mid-loop, aborting an invocation after it had already trained and
+    committed earlier cells."""
+    from mx_rcnn_tpu.tools import gauntlet
+
+    out = tmp_path / "results.json"
+    recs = _recs("e2e", [0.7])     # seed 0 only
+    recs[0]["epochs"] = 30         # committed baseline recipe
+    with open(out, "w") as f:
+        json.dump(recs, f)
+    trained = []
+    monkeypatch.setattr(gauntlet, "run_one",
+                        lambda args, mode, seed: trained.append((mode, seed)))
+    # seed 1 is missing (the old code would train it first); seed 0 exists
+    # under a different recipe — the invocation must refuse up front
+    with pytest.raises(SystemExit) as ex:
+        gauntlet.main(["--out", str(out), "--root", str(tmp_path),
+                       "--workdir", str(tmp_path / "w"),
+                       "--seeds", "1", "0", "--mode", "e2e",
+                       "--epochs", "2"])
+    assert ex.value.code == 2
+    assert "DIFFERENT recipe" in capsys.readouterr().err
+    assert trained == []           # nothing ran before the refusal
+    with open(out) as f:           # baseline untouched
+        assert json.load(f) == recs
+
+
+def test_summary_and_markdown_annotate_recipe(tmp_path):
+    """summarize/render_markdown must surface the recipe of every record
+    so mixed-recipe result files are visible, not silently aggregated
+    (ADVICE r5)."""
+    from mx_rcnn_tpu.tools.gauntlet import render_markdown, summarize
+
+    recs = _recs("e2e", [0.70, 0.71])
+    for r, ep in zip(recs, (30, 20)):
+        r.update(epochs=ep, lr=3e-3, lr_step=None, batch_images=2)
+    s = summarize(recs)["e2e/tiny"]
+    assert s["recipes"] == ["ep20/lr0.003/stepauto/bi2",
+                            "ep30/lr0.003/stepauto/bi2"]
+    md = tmp_path / "t.md"
+    render_markdown(recs, str(md))
+    text = md.read_text()
+    assert "| recipe |" in text
+    assert "ep30/lr0.003/stepauto/bi2" in text

@@ -1,0 +1,201 @@
+"""graphlint contract tests (ISSUE 1 tentpole).
+
+Two pinned properties:
+* the SHIPPED tree is clean — zero unwaived findings over ``mx_rcnn_tpu``
+  (every waiver carries a written reason), so ``make lint`` gates PRs;
+* the fixture file (``tests/fixtures/ops/graphlint_bad.py``) trips EVERY
+  rule — the linter cannot silently lose a rule.
+
+Plus behavioral tests of the parts that make the tool trustworthy: the
+static-expression classifier (what it must NOT flag), the jit-scope
+closure (host helpers called from traced code ARE flagged), and the
+waiver mechanism (reasoned waivers silence, bare waivers are findings).
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from mx_rcnn_tpu.analysis.graphlint import RULES, lint_paths, main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "mx_rcnn_tpu")
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "ops", "graphlint_bad.py")
+
+
+def test_shipped_tree_has_zero_unwaived_findings():
+    findings = lint_paths([PKG])
+    active = [f for f in findings if f.waived is None]
+    assert active == [], "\n".join(f.render() for f in active)
+    # waivers that do exist all carry reasons
+    for f in findings:
+        if f.waived is not None:
+            assert f.waived.strip(), f.render()
+
+
+def test_cli_exit_codes(capsys):
+    assert main([PKG]) == 0
+    assert main([FIXTURE]) == 1
+    capsys.readouterr()
+
+
+def test_fixture_trips_every_rule():
+    findings = lint_paths([FIXTURE])
+    codes = {f.code for f in findings}
+    assert codes == set(RULES), (
+        f"missing: {set(RULES) - codes}, unexpected: {codes - set(RULES)}")
+    # the reasonless GL401 waiver silences its finding but surfaces GL001
+    waived = [f for f in findings if f.waived is not None]
+    assert any(f.code == "GL401" for f in waived)
+    assert any(f.code == "GL001" for f in findings)
+
+
+def _lint_snippet(tmp_path, source):
+    d = tmp_path / "ops"
+    d.mkdir(exist_ok=True)
+    p = d / "snippet.py"
+    p.write_text(textwrap.dedent(source))
+    return lint_paths([str(p)])
+
+
+def test_static_expressions_are_not_flagged(tmp_path):
+    """Trace-time-static coercions, shape arithmetic, static branches and
+    host numpy over static values are all legitimate — zero findings."""
+    findings = _lint_snippet(tmp_path, """\
+        import functools
+        from typing import Tuple
+
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        def blocks(r: int) -> Tuple[int, int]:
+            return (8 if r >= 8 else r), 128
+
+        @functools.partial(jax.jit, static_argnames=("k", "flag"))
+        def fine(x, k: int = 4, flag: bool = False):
+            n = x.shape[0]
+            quota = int(round(0.5 * k))          # statics: no GL103
+            denom = float(x.size)                # .size is static
+            rb, cb = blocks(n)                   # static via return ann
+            if rb > 4:                           # static test: no GL203
+                x = x * 2.0
+            if flag:                             # static arg: no GL203
+                x = x + 1.0
+            grid = np.arange(k) * n              # numpy on statics: no GL101
+            pad = (-n) % rb
+            two = x[x.shape[0] - n]              # static index: no GL202
+            shp = (1,) + x.shape                 # tuple concat: no GL403
+            return x / denom, quota, grid, pad, two, shp
+        """)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_jit_closure_reaches_helpers(tmp_path):
+    """A helper CALLED from a jitted function inherits jit scope — the
+    host-sync in it is flagged even though the helper itself carries no
+    decorator."""
+    findings = _lint_snippet(tmp_path, """\
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        def helper(x):
+            return np.sum(x)     # traced caller -> GL101 here
+
+        @jax.jit
+        def entry(x):
+            return helper(x)
+        """)
+    assert [f.code for f in findings] == ["GL101"]
+    assert "helper" in findings[0].func
+
+
+def test_pragma_marks_factory_closures(tmp_path):
+    """``# graphlint: jit`` covers functions traced through indirection
+    (factory-returned closures); ``# graphlint: host`` opts a function
+    out of jit analysis entirely."""
+    findings = _lint_snippet(tmp_path, """\
+        import jax.numpy as jnp
+
+        def make_step():
+            # graphlint: jit
+            def step(x):
+                return float(x)          # GL103
+            return step
+
+        def host_tool(x):  # graphlint: host
+            return float(x)              # host scope: clean
+        """)
+    assert [f.code for f in findings] == ["GL103"]
+    assert "step" in findings[0].func
+
+
+def test_waiver_requires_reason(tmp_path):
+    reasoned = _lint_snippet(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return float(x)  # graphlint: disable=GL103 benchmark scaffold
+        """)
+    assert [f.code for f in reasoned] == ["GL103"]
+    assert reasoned[0].waived == "benchmark scaffold"
+    bare = _lint_snippet(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return float(x)  # graphlint: disable=GL103
+        """)
+    codes = {f.code for f in bare}
+    assert "GL001" in codes  # the bare waiver is itself a finding
+
+
+def test_flax_methods_are_jit_scope(tmp_path):
+    findings = _lint_snippet(tmp_path, """\
+        import flax.linen as nn
+        import jax.numpy as jnp
+
+        class Net(nn.Module):
+            def __call__(self, x):
+                return jnp.nonzero(x)    # GL201 inside a module method
+        """)
+    assert [f.code for f in findings] == ["GL201"]
+
+
+def test_list_rules_names_every_code(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for code in RULES:
+        assert code in out
+
+
+def test_checkout_path_does_not_leak_into_graph_scope(tmp_path):
+    """A checkout under a directory named 'models' (or ops/core/parallel)
+    must not classify host modules as graph scope: scope derives from the
+    path relative to the linted root, not absolute components."""
+    pkg = tmp_path / "models" / "pkg"
+    (pkg / "data").mkdir(parents=True)
+    (pkg / "data" / "host.py").write_text(
+        "import numpy as np\nX = np.float64(1.0)\n")
+    findings = lint_paths([str(pkg)])
+    assert findings == [], "\n".join(f.render() for f in findings)
+    # ...while a real graph-scope dir under the same root still counts
+    (pkg / "ops").mkdir()
+    (pkg / "ops" / "g.py").write_text(
+        "import numpy as np\nX = np.float64(1.0)\n")
+    codes = [f.code for f in lint_paths([str(pkg)])]
+    assert codes == ["GL401"]
+
+
+def test_cli_fails_on_missing_or_empty_paths(tmp_path, capsys):
+    """A typo'd path must fail the gate, not lint zero files and pass."""
+    assert main([str(tmp_path / "no_such_dir")]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main([str(empty)]) == 2
+    capsys.readouterr()

@@ -1,0 +1,187 @@
+"""Recompile/leak guard — the RUNTIME twin of graphlint (ISSUE 1).
+
+graphlint catches graph-hygiene bugs the AST can see; this harness
+catches what it cannot: the canonical tiny train step must compile
+**exactly once per (mode, shape bucket)**, and the fused proposal/target
+ops must not leak tracers.
+
+Compiles are counted two ways, because they fail differently:
+
+* ``jitted._cache_size()`` — entries in the callable's in-memory pjit
+  cache.  Immune to the persistent XLA compilation cache the test
+  harness keeps warm (``tests/conftest.py``), so the per-bucket budget
+  is exact across cold and warm runs.
+* ``jax.monitoring`` lowering events
+  (``/jax/core/compile/jaxpr_to_mlir_module_duration``) — fired on every
+  pjit cache MISS regardless of whether the backend compile later hits
+  the persistent cache.  This is the detector that catches the per-call
+  ``jax.jit(functools.partial(...))`` anti-pattern (graphlint GL301): a
+  fresh wrapper per step keeps each wrapper's ``_cache_size()`` at 1
+  while re-tracing and re-lowering every call.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.core.train import Batch, make_train_step, setup_training
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.ops.anchors import generate_shifted_anchors
+from mx_rcnn_tpu.ops.proposal import propose
+from mx_rcnn_tpu.ops.targets import anchor_target, proposal_target
+
+KEY = jax.random.PRNGKey(0)
+
+# the two shape buckets of the canonical tiny recipe: one XLA program
+# per bucket serves the whole epoch (docs/DESIGN.md)
+BUCKETS = ((64, 64), (64, 96))
+
+_EVENTS = {"lowerings": 0}
+
+
+def _on_event_duration(event, duration, **kw):
+    if event == "/jax/core/compile/jaxpr_to_mlir_module_duration":
+        _EVENTS["lowerings"] += 1
+
+
+jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
+
+
+class lowering_count:
+    """Counts pjit lowerings (cache misses) inside a ``with`` block."""
+
+    def __enter__(self):
+        self._start = _EVENTS["lowerings"]
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    @property
+    def n(self) -> int:
+        return _EVENTS["lowerings"] - self._start
+
+
+def _tiny_setup(batch_images=1):
+    cfg = generate_config("tiny", "PascalVOC")
+    cfg = cfg.replace_in("train", rpn_pre_nms_top_n=64,
+                         rpn_post_nms_top_n=16, batch_rois=8,
+                         max_gt_boxes=4, rpn_min_size=2, rpn_batch_size=32)
+    model = build_model(cfg)
+    state, tx = setup_training(
+        model, cfg, KEY, (batch_images,) + BUCKETS[0] + (3,),
+        steps_per_epoch=10)
+    return cfg, model, tx, state
+
+
+def _bucket_batch(h, w, n=1, seed=0):
+    rng = np.random.RandomState(seed)
+    images = jnp.asarray(rng.randn(n, h, w, 3).astype(np.float32))
+    im_info = jnp.tile(jnp.asarray([[float(h), float(w), 1.0]]), (n, 1))
+    g = 4
+    gt_boxes = jnp.zeros((n, g, 4)).at[:, 0].set(
+        jnp.asarray([8.0, 8.0, 40.0, 36.0]))
+    gt_classes = jnp.zeros((n, g), jnp.int32).at[:, 0].set(3)
+    gt_valid = jnp.zeros((n, g), bool).at[:, 0].set(True)
+    return Batch(images, im_info, gt_boxes, gt_classes, gt_valid)
+
+
+@pytest.mark.slow
+def test_train_step_compiles_once_per_mode_and_bucket():
+    """The pinned budget: ONE compile per (mode, shape bucket), zero
+    recompiles on every later step."""
+    cfg, model, tx, state = _tiny_setup()
+    batches = {b: _bucket_batch(*b) for b in BUCKETS}
+
+    for mode in ("e2e", "rpn"):
+        step = jax.jit(make_train_step(model, cfg, tx, mode=mode))
+        for b in BUCKETS:
+            for _ in range(2):  # second pass must hit the cache
+                state2, metrics = step(state, batches[b], KEY)
+                jax.block_until_ready(metrics)
+        assert step._cache_size() == len(BUCKETS), (
+            f"mode={mode}: {step._cache_size()} compiles for "
+            f"{len(BUCKETS)} buckets")
+        # warm steps must not lower anything — the whole-epoch invariant
+        with lowering_count() as lc:
+            for b in BUCKETS:
+                _, metrics = step(state, batches[b], KEY)
+                jax.block_until_ready(metrics)
+        assert lc.n == 0, f"mode={mode}: {lc.n} recompiles on warm steps"
+
+
+@pytest.mark.slow
+def test_per_call_jit_partial_trips_the_guard():
+    """The deliberately injected anti-pattern (graphlint GL301 at
+    runtime): wrapping the step in a FRESH ``jax.jit(partial(...))``
+    every call re-traces per step.  ``_cache_size()`` on each fresh
+    wrapper stays 1 — only the monitoring counter sees the churn, which
+    is why the guard watches lowering events."""
+    cfg, model, tx, state = _tiny_setup()
+    step_fn = make_train_step(model, cfg, tx)
+    batch = _bucket_batch(*BUCKETS[0])
+
+    # healthy pattern first: jit once, warm it, then measure zero
+    good = jax.jit(step_fn)
+    _, m = good(state, batch, KEY)
+    jax.block_until_ready(m)
+    with lowering_count() as lc:
+        for _ in range(3):
+            _, m = good(state, batch, KEY)
+            jax.block_until_ready(m)
+    assert lc.n == 0
+
+    calls = 3
+    with lowering_count() as lc:
+        for _ in range(calls):
+            bad = jax.jit(functools.partial(step_fn))
+            _, m = bad(state, batch, KEY)
+            jax.block_until_ready(m)
+            assert bad._cache_size() == 1  # blind to the churn
+    assert lc.n >= calls, (
+        f"guard missed the per-call jit churn: {lc.n} lowerings "
+        f"for {calls} calls")
+
+
+def test_fused_ops_trace_without_leaks():
+    """``jax.checking_leaks`` over the fused proposal/target ops — odd
+    grid sizes force a fresh trace (cached signatures would make the
+    check vacuous)."""
+    anchors = jnp.asarray(generate_shifted_anchors(5, 7, 16))
+    n = anchors.shape[0]
+    gt = jnp.asarray([[8.0, 8.0, 60.0, 52.0], [0.0, 0.0, 0.0, 0.0]])
+    gt_valid = jnp.asarray([True, False])
+    gt_classes = jnp.asarray([3, 0], jnp.int32)
+    im_info = jnp.asarray([80.0, 112.0, 1.0])
+    with jax.checking_leaks():
+        at = anchor_target(anchors, gt, gt_valid, im_info, KEY,
+                           rpn_batch_size=16)
+        jax.block_until_ready(at.labels)
+        scores = jax.random.uniform(KEY, (n,))
+        deltas = jnp.zeros((n, 4))
+        rois, roi_scores, roi_valid = propose(
+            scores, deltas, anchors, im_info, pre_nms_top_n=32,
+            post_nms_top_n=8, min_size=2)
+        jax.block_until_ready(rois)
+        pt = proposal_target(rois, roi_valid, gt, gt_classes, gt_valid,
+                             KEY, num_classes=5, batch_rois=8)
+        jax.block_until_ready(pt.rois)
+
+
+def test_leak_guard_detects_a_planted_leak():
+    """Sensitivity check: the harness must actually catch a leaked
+    tracer, or the clean run above proves nothing."""
+    stash = []
+
+    @jax.jit
+    def leaky(x):
+        stash.append(x)  # the classic bug: tracer escapes via closure
+        return x * 2
+
+    with pytest.raises(Exception, match="[Ll]eak"):
+        with jax.checking_leaks():
+            jax.block_until_ready(leaky(jnp.ones((4,))))

@@ -159,3 +159,24 @@ def test_roi_align_batched_dispatch():
     assert out.shape == (1, 4, 7, 7, 16)
     with pytest.raises(ValueError, match="unknown roi_align backend"):
         roi_align_batched(feat, rois, backend="cuda")
+
+
+def test_roi_align_pallas_rois_grad_is_explicit_zeros():
+    """ADVICE r5: the custom-VJP bwd must return a zeros cotangent for
+    rois, not bare None — grads w.r.t. rois then trace cleanly while rois
+    stay non-differentiable data (like the reference ROIPooling)."""
+    from mx_rcnn_tpu.ops.roi_align_pallas import roi_align_pallas
+
+    rng = np.random.RandomState(3)
+    n, h, w, c, r = 1, 8, 8, 16, 4
+    feat = jnp.asarray(rng.randn(n, h, w, c).astype(np.float32))
+    rois = jnp.asarray(_rand_rois(rng, n, r, h * 16, w * 16))
+
+    g_feat, g_rois = jax.grad(
+        lambda f, b: jnp.sum(roi_align_pallas(f, b, (7, 7), 1 / 16.0, 2,
+                                              True)),
+        argnums=(0, 1))(feat, rois)
+    assert g_rois.shape == rois.shape
+    assert g_rois.dtype == rois.dtype
+    assert not np.any(np.asarray(g_rois))
+    assert np.any(np.asarray(g_feat))

@@ -176,3 +176,40 @@ def test_proposal_target_no_fg_bg_confusion_at_scale():
     assert not ((labels == 0) & (iou >= 0.5)).any()
     # selection is exhaustive: 32 fg + 96 bg, no filler needed
     assert (labels >= 0).all()
+
+
+def test_choose_k_exact_count_and_subset():
+    """_choose_k must select exactly min(quota, count(mask)) elements,
+    all inside the mask (ADVICE r5: the old value-threshold selection
+    could exceed the quota on fp32 ties)."""
+    from mx_rcnn_tpu.ops.targets import _choose_k
+
+    mask = jnp.array([True] * 10 + [False] * 6)
+    for i in range(8):
+        sel = _choose_k(jax.random.PRNGKey(i), mask, 8, 8)
+        assert int(sel.sum()) == 8
+        assert not bool((sel & ~mask).any())
+    # quota above count(mask): every mask element, nothing else
+    sel = _choose_k(KEY, jnp.array([True] * 3 + [False] * 13), 8, 8)
+    assert int(sel.sum()) == 3
+    # zero quota selects nothing
+    assert int(_choose_k(KEY, mask, 8, 0).sum()) == 0
+
+
+def test_choose_k_exact_under_fp32_ties(monkeypatch):
+    """Force every uniform draw to collide: the old ``r <= thr`` selection
+    then kept ALL mask elements; scatter-at-top_k-indices must still
+    return exactly quota Trues (ADVICE r5 regression)."""
+    from mx_rcnn_tpu.ops import targets
+
+    monkeypatch.setattr(targets.jax.random, "uniform",
+                        lambda key, shape: jnp.full(shape, 0.5))
+    mask = jnp.array([True] * 12 + [False] * 4)
+    sel = targets._choose_k(KEY, mask, 8, 5)
+    assert int(sel.sum()) == 5
+    assert not bool((sel & ~mask).any())
+    # duplicated values tied across the mask boundary must not leak
+    # masked-out slots into the selection either
+    sel_all = targets._choose_k(KEY, mask, 16, 16)
+    assert int(sel_all.sum()) == 12
+    assert not bool((sel_all & ~mask).any())
