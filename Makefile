@@ -13,7 +13,7 @@ NATIVE_DIR := mx_rcnn_tpu/native
 NATIVE_LIB := $(NATIVE_DIR)/libmxrcnn_native.so
 NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 
-.PHONY: all native lint test test-all test-gate clean
+.PHONY: all native lint test test-all test-gate serve-smoke clean
 
 all: native
 
@@ -41,12 +41,21 @@ test:
 test-all:
 	python -m pytest tests/ -x -q -m "not gate"
 
+# serving smoke (docs/SERVING.md): loadgen against an in-process warmed
+# engine on synthetic images — fails unless every request terminates
+# (zero lost), the warmed engine performs ZERO recompiles, and serving
+# throughput holds >= 50% of the offline Predictor rate (tolerant floor
+# for a contended 1-core box; the measured headline ratio is recorded
+# in docs/SERVING.md).  ~30 s.
+serve-smoke:
+	python -m mx_rcnn_tpu.tools.loadgen --smoke --check
+
 # the two end-metric gates (30-epoch gauntlet seed-0 from scratch
 # ~22 min, 16-device hierarchical dryrun ~7 min on one core) — run
 # these for round-gate evidence; test-all stays green without them.
 # graphlint runs first: a hygiene violation fails the gate in seconds
-# instead of after 30 minutes of training
-test-gate: lint
+# instead of after 30 minutes of training; serve-smoke next (~30 s)
+test-gate: lint serve-smoke
 	python -m pytest tests/ -x -q -m "gate"
 
 clean:

@@ -224,6 +224,27 @@ def _postprocess_batch(rois, roi_valid, cls_prob, deltas, im_info, scales,
     return boxes_b, scores_b, keep_b
 
 
+def detections_from_keep(boxes_b: np.ndarray, scores_b: np.ndarray,
+                         keep_b: np.ndarray, j: int) -> Dict[int, np.ndarray]:
+    """Per-image detection dict from :func:`_postprocess_batch` outputs:
+    row ``j`` → ``{class_id: (k, 5) [x1 y1 x2 y2 score]}`` over the
+    foreground classes.  ONE implementation shared by the demo
+    (``tools/demo.py``) and the serving engine (``serve/engine.py``) so a
+    response can never disagree with the eval-path postprocess on how the
+    keep mask demultiplexes into detections."""
+    r = boxes_b.shape[1]
+    num_classes = scores_b.shape[-1]
+    boxes = boxes_b[j].reshape(r, num_classes, 4)
+    out: Dict[int, np.ndarray] = {}
+    for c in range(1, num_classes):
+        keep = keep_b[j, c]
+        if keep.any():
+            out[c] = np.hstack([boxes[keep, c],
+                                scores_b[j][keep, c, None]]
+                               ).astype(np.float32)
+    return out
+
+
 def im_detect_batch(
     rois: np.ndarray,
     roi_valid: np.ndarray,

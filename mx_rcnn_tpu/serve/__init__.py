@@ -1,0 +1,22 @@
+"""Online detection serving (docs/SERVING.md) — the first ONLINE workload
+class in the repo; everything before it was offline (ISSUE 2).
+
+Layers, bottom-up:
+
+* ``queue.py``   — bounded admission queues, deadlines, load shedding;
+* ``metrics.py`` — counters + latency histograms + the recompile guard;
+* ``engine.py``  — per-bucket dynamic micro-batching over ``Predictor``,
+  sharing the eval path's jitted postprocess bit for bit;
+* ``server.py``  — stdlib JSON/HTTP front end (/detect /healthz /metrics).
+
+Entry points: ``python -m mx_rcnn_tpu.tools.serve`` (checkpoint → warmed
+HTTP service) and ``python -m mx_rcnn_tpu.tools.loadgen`` (closed/open
+loop load generation + BENCH-style JSON).
+"""
+
+from mx_rcnn_tpu.serve.engine import ServingEngine  # noqa: F401
+from mx_rcnn_tpu.serve.metrics import (Histogram, LoweringCounter,  # noqa: F401
+                                       ServeMetrics)
+from mx_rcnn_tpu.serve.queue import (BoundedQueue, DeadlineExceeded,  # noqa: F401
+                                     RequestFailed, ServeRequest, ShedError)
+from mx_rcnn_tpu.serve.server import make_server  # noqa: F401

@@ -1,0 +1,299 @@
+"""Online detection serving engine: dynamic micro-batching over the
+static-bucket ``Predictor``.
+
+No reference equivalent — every inference path in the reference (and in
+this repo before this subsystem) is offline.  The engine turns the
+mesh-shardable :class:`~mx_rcnn_tpu.core.tester.Predictor` plus the
+one-fixed-shape-program ``_postprocess_batch`` into a request/response
+service:
+
+* a request is ONE image; ``submit`` resizes/pads it with the exact
+  train/eval preprocessing (``data/image.py — resize_to_bucket``) and
+  routes it to its shape bucket's bounded queue (``serve/queue.py``);
+* one dispatcher thread per bucket coalesces requests into micro-batches
+  under a max-batch / max-delay policy, ALWAYS padding the batch to the
+  static ``cfg.serve.batch_size`` rows — so exactly one XLA program per
+  bucket serves all traffic and steady-state serving is recompile-free
+  (the serving analog of the static train/eval buckets; asserted by the
+  ``LoweringCounter`` guard in tests and ``tools/loadgen.py``);
+* the batch runs through ``Predictor.raw`` + the SAME jitted
+  ``_postprocess_batch`` the eval loop uses, and per-request detections
+  demultiplex through the shared ``detections_from_keep`` — serving can
+  never disagree with eval on postprocess semantics;
+* :meth:`warmup` pre-compiles every bucket program (plus the shared
+  postprocess) before the first request, so no client ever pays a
+  compile.
+
+Overload semantics live in ``serve/queue.py`` (shed at the watermark,
+cancel expired work before dispatch); latency accounting in
+``serve/metrics.py``; the HTTP front end in ``serve/server.py``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.core.tester import (Predictor, _postprocess_batch,
+                                     detections_from_keep, tiled_bbox_stats)
+from mx_rcnn_tpu.data.image import resize_to_bucket
+from mx_rcnn_tpu.serve.metrics import ServeMetrics
+from mx_rcnn_tpu.serve.queue import (EXPIRED, FAILED, SERVED, SHED,
+                                     BoundedQueue, ServeRequest)
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+
+class ServingEngine:
+    """Asynchronous micro-batching front end over a :class:`Predictor`.
+
+    ``start=False`` builds the engine without dispatcher threads (tests
+    use it to pin admission-control behavior deterministically); call
+    :meth:`start` to begin serving.  :meth:`close` drains and joins.
+    """
+
+    def __init__(self, predictor: Predictor, cfg: Config,
+                 metrics: ServeMetrics = None, start: bool = True):
+        s = cfg.serve
+        if s.batch_size < 1:
+            raise ValueError(f"serve.batch_size must be >= 1, got "
+                             f"{s.batch_size}")
+        if s.max_delay_ms < 0:
+            raise ValueError(f"serve.max_delay_ms must be >= 0, got "
+                             f"{s.max_delay_ms}")
+        if s.shed_watermark > s.queue_depth:
+            raise ValueError(
+                f"serve.shed_watermark ({s.shed_watermark}) exceeds "
+                f"queue_depth ({s.queue_depth})")
+        self.predictor = predictor
+        self.cfg = cfg
+        self.metrics = metrics or ServeMetrics()
+        self.buckets: Tuple[Tuple[int, int], ...] = tuple(
+            tuple(b) for b in cfg.bucket.shapes)
+        self.queues: Dict[Tuple[int, int], BoundedQueue] = {
+            b: BoundedQueue(s.queue_depth, s.shed_watermark)
+            for b in self.buckets}
+        self._stds, self._means = tiled_bbox_stats(cfg, cfg.num_classes)
+        self._threads: List[threading.Thread] = []
+        self._closed = False
+        self._warm_programs = 0
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------------
+    # request path (caller threads)
+    # ------------------------------------------------------------------
+
+    def preprocess(self, img: np.ndarray
+                   ) -> Tuple[np.ndarray, np.ndarray, Tuple[int, int]]:
+        """RGB uint8 (h, w, 3) → (padded fp32 bucket canvas, im_info (3,),
+        bucket) — the train/eval preprocessing, byte for byte
+        (``resize_to_bucket``), so a served image sees exactly the pixels
+        an offline eval of the same image would."""
+        data, im_scale, bucket = resize_to_bucket(
+            img, self.cfg.network.pixel_means, self.cfg.bucket.scale,
+            self.cfg.bucket.max_size, self.buckets)
+        h, w = img.shape[:2]
+        im_info = np.array([round(h * im_scale), round(w * im_scale),
+                            im_scale], np.float32)
+        return data, im_info, bucket
+
+    def submit(self, img: np.ndarray,
+               timeout_ms: float = None) -> ServeRequest:
+        """Admit one image; returns the request handle immediately.
+        The handle terminates as SERVED / SHED / EXPIRED / FAILED —
+        ``handle.wait()`` blocks and raises the matching error class.
+        ``timeout_ms`` overrides ``cfg.serve.default_timeout_ms``
+        (0 = no deadline)."""
+        from mx_rcnn_tpu.data.image import choose_bucket, compute_scale
+
+        now = time.monotonic()
+        t = (self.cfg.serve.default_timeout_ms if timeout_ms is None
+             else timeout_ms)
+        deadline = now + t / 1000.0 if t and t > 0 else None
+        # cheap dims-only admission pre-check BEFORE any pixel work: under
+        # exactly the overload shedding exists for, a rejected request
+        # must not pay the resize/pad either (shape math only; the offer
+        # below stays the authoritative depth check)
+        h, w = img.shape[:2]
+        s = compute_scale(h, w, self.cfg.bucket.scale,
+                          self.cfg.bucket.max_size)
+        rough_bucket = choose_bucket(int(round(h * s)), int(round(w * s)),
+                                     self.buckets)
+        if self._closed or (len(self.queues[rough_bucket])
+                            >= self.queues[rough_bucket].shed_watermark):
+            req = ServeRequest(None, None, rough_bucket, deadline, now)
+            self.metrics.count("submitted")
+            req._finish(SHED)
+            self.metrics.count("shed")
+            return req
+        data, im_info, bucket = self.preprocess(img)
+        req = ServeRequest(data, im_info, bucket, deadline, now)
+        self.metrics.count("submitted")
+        if self._closed or not self.queues[bucket].offer(req):
+            req._finish(SHED)
+            self.metrics.count("shed")
+        return req
+
+    def detect(self, img: np.ndarray, timeout_ms: float = None
+               ) -> Dict[int, np.ndarray]:
+        """Synchronous convenience: submit + wait.  Returns
+        ``{class_id: (k, 5) [x1 y1 x2 y2 score]}`` in raw image
+        coordinates, or raises ShedError / DeadlineExceeded /
+        RequestFailed."""
+        req = self.submit(img, timeout_ms=timeout_ms)
+        # bound the wait a little past the deadline: the dispatcher is the
+        # authority on EXPIRED, the slack covers its wakeup latency
+        wait_s = None
+        if req.deadline is not None:
+            wait_s = max(req.deadline - time.monotonic(), 0.0) + 30.0
+        return req.wait(timeout=wait_s)
+
+    # ------------------------------------------------------------------
+    # dispatch path (one thread per bucket)
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._threads:
+            return
+        for bucket in self.buckets:
+            t = threading.Thread(target=self._dispatcher, args=(bucket,),
+                                 name=f"serve-dispatch-{bucket[0]}x"
+                                      f"{bucket[1]}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _dispatcher(self, bucket: Tuple[int, int]) -> None:
+        q = self.queues[bucket]
+        s = self.cfg.serve
+        on_expire = lambda req: self.metrics.count("expired")  # noqa: E731
+        while True:
+            batch = q.take_batch(s.batch_size, s.max_delay_ms / 1000.0,
+                                 on_expire=on_expire)
+            if not batch:
+                return  # closed and drained
+            self._serve_batch(bucket, batch)
+
+    def _compose(self, bucket: Tuple[int, int],
+                 reqs: List[ServeRequest]
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        """Static-shape batch assembly: real rows first, then zero-image
+        pad rows with im_info (bh, bw, 1.0) — the same dead-row convention
+        as the Predictor's mesh padding, so pad rows trace the normal
+        program path and can never emit NaNs."""
+        bh, bw = bucket
+        n = self.cfg.serve.batch_size
+        images = np.zeros((n, bh, bw, 3), np.float32)
+        im_info = np.tile(np.array([bh, bw, 1.0], np.float32), (n, 1))
+        for j, r in enumerate(reqs):
+            images[j] = r.image
+            im_info[j] = r.im_info
+        return images, im_info
+
+    def _run(self, images: np.ndarray, im_info: np.ndarray
+             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Forward + the eval-shared postprocess for one padded batch."""
+        import jax.numpy as jnp
+
+        rois, roi_valid, cls_prob, deltas = self.predictor.raw(images,
+                                                               im_info)
+        return tuple(map(np.asarray, _postprocess_batch(
+            rois, roi_valid, cls_prob, deltas, jnp.asarray(im_info),
+            jnp.asarray(im_info[:, 2]), self._stds, self._means,
+            nms_thresh=self.cfg.test.nms,
+            score_thresh=self.cfg.serve.score_thresh)))
+
+    def _serve_batch(self, bucket: Tuple[int, int],
+                     reqs: List[ServeRequest]) -> None:
+        """Run one micro-batch and terminate EVERY rider.  The whole body
+        is fenced: any exception (forward, metrics, demux) FAILs the
+        unfinished riders instead of leaving them PENDING forever and
+        killing the bucket's only dispatcher thread."""
+        try:
+            now = time.monotonic()
+            for r in reqs:
+                r.dispatch_t = now
+                self.metrics.observe("queue_wait_ms",
+                                     (now - r.enqueue_t) * 1e3)
+            images, im_info = self._compose(bucket, reqs)
+            t0 = time.monotonic()
+            boxes_b, scores_b, keep_b = self._run(images, im_info)
+            self.metrics.observe_batch(len(reqs),
+                                       self.cfg.serve.batch_size,
+                                       (time.monotonic() - t0) * 1e3)
+            for j, r in enumerate(reqs):
+                # deadline re-check at completion: a request alive when
+                # collected can expire during the coalescing window or the
+                # model run — it must terminate as EXPIRED (504), never as
+                # a late 200 (the third enforcement point, serve/queue.py)
+                if r.expired(time.monotonic()):
+                    if r._finish(EXPIRED):
+                        self.metrics.count("expired")
+                    continue
+                dets = detections_from_keep(boxes_b, scores_b, keep_b, j)
+                r.batch_rows = len(reqs)
+                if r._finish(SERVED, result=dets):
+                    self.metrics.count("served")
+                    self.metrics.observe("total_ms",
+                                         (r.done_t - r.enqueue_t) * 1e3)
+        except Exception as e:  # terminate every rider, never deadlock
+            logger.exception("serve batch failed (bucket %s)", bucket)
+            for r in reqs:
+                if r._finish(FAILED, error=e):
+                    self.metrics.count("failed")
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def warmup(self) -> int:
+        """Pre-compile every per-bucket forward program plus the shared
+        postprocess by running one full dummy batch per bucket — after
+        this, steady-state serving performs ZERO compiles (the acceptance
+        invariant; ``tools/loadgen.py`` and the tests assert it with
+        :class:`~mx_rcnn_tpu.serve.metrics.LoweringCounter`).  Returns the
+        number of per-bucket forward programs now resident."""
+        for bucket in self.buckets:
+            bh, bw = bucket
+            n = self.cfg.serve.batch_size
+            images = np.zeros((n, bh, bw, 3), np.float32)
+            im_info = np.tile(np.array([bh, bw, 1.0], np.float32), (n, 1))
+            self._run(images, im_info)
+        self._warm_programs = len(self.predictor._fns)
+        logger.info("serve warmup: %d bucket program(s) + shared "
+                    "postprocess compiled", self._warm_programs)
+        return self._warm_programs
+
+    def program_count(self) -> int:
+        """Resident per-bucket forward programs (the Predictor's
+        per-(mode, shape, dtype) jit cache) — growth after warmup means a
+        recompile leak."""
+        return len(self.predictor._fns)
+
+    def healthz(self) -> Dict:
+        return {
+            "ok": not self._closed,
+            "buckets": [list(b) for b in self.buckets],
+            "batch_size": self.cfg.serve.batch_size,
+            "warm_programs": self._warm_programs,
+            "programs": self.program_count(),
+            "queue_depths": {f"{b[0]}x{b[1]}": len(q)
+                             for b, q in self.queues.items()},
+        }
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop admitting, shed whatever is still queued, join the
+        dispatchers (in-flight batches finish serving)."""
+        self._closed = True
+        for q in self.queues.values():
+            for req in q.close():
+                if req._finish(SHED):
+                    self.metrics.count("shed")
+        for t in self._threads:
+            t.join(timeout)
+        self._threads = []

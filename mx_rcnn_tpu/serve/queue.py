@@ -1,0 +1,215 @@
+"""Bounded admission queues with deadlines and explicit load shedding.
+
+No reference equivalent — the reference repo has no online inference path.
+This is the admission-control half of the serving engine
+(``serve/engine.py``): a request is accepted only while the queue is under
+its shed watermark, carries an optional deadline, and is guaranteed to
+terminate in exactly ONE of four states (``SERVED`` / ``SHED`` /
+``EXPIRED`` / ``FAILED``).  Overload therefore degrades by rejecting
+excess work up front (the client sees an immediate 429 and can retry
+elsewhere) instead of letting queue depth grow until every request times
+out — the classic collapse mode of an unbounded serving queue.
+
+Deadlines are enforced at three points: batch collection (expired
+requests are cancelled BEFORE dispatch, so dead work never occupies a
+micro-batch slot), completion (a request that expired while coalescing
+or during the model run terminates EXPIRED, never as a late success —
+``engine.py — _serve_batch``), and the caller's ``wait`` (which raises
+``DeadlineExceeded`` for any EXPIRED terminal state).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+
+class ShedError(RuntimeError):
+    """Request rejected at admission: queue at/over its shed watermark
+    (HTTP 429 semantics — the client should back off or retry elsewhere)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """Request missed its deadline before a result was produced
+    (HTTP 504 semantics)."""
+
+
+class RequestFailed(RuntimeError):
+    """The engine hit an internal error while serving this request
+    (HTTP 500 semantics); the original exception is chained."""
+
+
+# terminal request states — the accounting invariant is that every
+# submitted request reaches exactly one of these (asserted by loadgen's
+# zero-lost check and tests/test_serve.py)
+PENDING = "pending"
+SERVED = "served"
+SHED = "shed"
+EXPIRED = "expired"
+FAILED = "failed"
+
+
+class ServeRequest:
+    """One in-flight detection request.
+
+    Created by ``ServingEngine.submit``; the caller blocks on
+    :meth:`wait` (or polls :attr:`state`) while the dispatcher thread
+    fills :attr:`result`.  All transitions go through ``_finish`` under
+    the lock, so a request can never terminate twice.
+    """
+
+    __slots__ = ("image", "im_info", "bucket", "enqueue_t", "deadline",
+                 "state", "result", "error", "dispatch_t", "done_t",
+                 "batch_rows", "_event", "_lock")
+
+    def __init__(self, image: np.ndarray, im_info: np.ndarray,
+                 bucket: Tuple[int, int], deadline: Optional[float],
+                 now: float):
+        self.image = image          # (bh, bw, 3) fp32, padded into bucket
+        self.im_info = im_info      # (3,) fp32 — (h, w, im_scale)
+        self.bucket = bucket
+        self.enqueue_t = now
+        self.deadline = deadline    # absolute time.monotonic() or None
+        self.state = PENDING
+        self.result = None          # {class_id: (k, 5) array} when SERVED
+        self.error: Optional[BaseException] = None
+        self.dispatch_t: Optional[float] = None
+        self.done_t: Optional[float] = None
+        self.batch_rows = 0         # real rows in the micro-batch served with
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+
+    def _finish(self, state: str, result=None,
+                error: BaseException = None, now: float = None) -> bool:
+        """Atomically move to a terminal state; False if already terminal."""
+        with self._lock:
+            if self.state != PENDING:
+                return False
+            self.state = state
+            self.result = result
+            self.error = error
+            self.done_t = time.monotonic() if now is None else now
+        self._event.set()
+        return True
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now >= self.deadline
+
+    def wait(self, timeout: float = None):
+        """Block until the request terminates; returns the detection dict
+        or raises the matching error class.  ``timeout`` (seconds) bounds
+        the wait independently of the request deadline."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("request still pending after wait timeout")
+        if self.state == SERVED:
+            return self.result
+        if self.state == SHED:
+            raise ShedError("request shed at admission (queue over "
+                            "watermark)")
+        if self.state == EXPIRED:
+            raise DeadlineExceeded("request deadline expired before serve")
+        raise RequestFailed("engine error while serving request") \
+            from self.error
+
+    # latency accounting (None until the matching transition happened)
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self.dispatch_t is None:
+            return None
+        return self.dispatch_t - self.enqueue_t
+
+    @property
+    def total_s(self) -> Optional[float]:
+        if self.done_t is None:
+            return None
+        return self.done_t - self.enqueue_t
+
+
+class BoundedQueue:
+    """FIFO request queue with a hard depth cap, a shed watermark, and
+    deadline-aware batch collection.
+
+    ``offer`` rejects (returns False) when depth >= ``shed_watermark`` —
+    callers mark the request SHED.  ``take_batch`` blocks for the first
+    request, then coalesces up to ``max_n`` requests, waiting at most
+    ``max_delay_s`` past the first take for stragglers; expired requests
+    are cancelled (marked EXPIRED) instead of returned, so the dispatch
+    batch only ever carries live work.
+    """
+
+    def __init__(self, depth: int, shed_watermark: int = None):
+        if depth < 1:
+            raise ValueError(f"queue depth must be >= 1, got {depth}")
+        self.depth = depth
+        self.shed_watermark = min(depth, shed_watermark or depth)
+        if self.shed_watermark < 1:
+            raise ValueError(
+                f"shed_watermark must be >= 1, got {self.shed_watermark}")
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    def offer(self, req: ServeRequest) -> bool:
+        """Admit ``req`` unless the queue is at its watermark (or closed).
+        Returns False on shed — the caller owns marking the request."""
+        with self._cond:
+            if self._closed or len(self._q) >= self.shed_watermark:
+                return False
+            self._q.append(req)
+            self._cond.notify()
+            return True
+
+    def take_batch(self, max_n: int, max_delay_s: float,
+                   now_fn: Callable[[], float] = time.monotonic,
+                   on_expire: Callable[[ServeRequest], None] = None
+                   ) -> List[ServeRequest]:
+        """Collect the next micro-batch (empty list means: queue closed and
+        drained).  Blocks indefinitely for the first request; once one is
+        held, the coalescing window (``max_delay_s``, anchored at the first
+        take) bounds how long stragglers are waited for — the max-batch /
+        max-delay policy.  ``on_expire`` fires (after the terminal
+        transition) for every request cancelled here, so the caller can
+        account the expiry."""
+        batch: List[ServeRequest] = []
+        window_end: Optional[float] = None
+        with self._cond:
+            while True:
+                # drain available requests, cancelling expired ones
+                while self._q and len(batch) < max_n:
+                    req = self._q.popleft()
+                    if req.expired(now_fn()):
+                        if req._finish(EXPIRED) and on_expire is not None:
+                            on_expire(req)
+                        continue
+                    batch.append(req)
+                    if window_end is None:
+                        window_end = now_fn() + max_delay_s
+                if len(batch) >= max_n:
+                    return batch
+                if batch:
+                    remaining = window_end - now_fn()
+                    if remaining <= 0 or self._closed:
+                        return batch  # window closed: dispatch partial
+                    self._cond.wait(timeout=remaining)
+                else:
+                    if self._closed:
+                        return batch  # empty — dispatcher should exit
+                    self._cond.wait()  # woken by offer() / close()
+
+    def close(self) -> List[ServeRequest]:
+        """Stop admitting; wake dispatchers; return whatever was still
+        queued (callers decide how to terminate the leftovers)."""
+        with self._cond:
+            self._closed = True
+            leftovers = list(self._q)
+            self._q.clear()
+            self._cond.notify_all()
+        return leftovers

@@ -1,0 +1,168 @@
+"""Stdlib-only JSON HTTP front end for the serving engine.
+
+``http.server.ThreadingHTTPServer`` — one handler thread per connection;
+the handler threads are exactly the concurrent submitters the engine's
+micro-batcher coalesces, so no extra thread pool is needed and the whole
+front end runs under the CPU tier-1 environment with zero new
+dependencies.  Not a hardened internet-facing server (no TLS, no auth);
+it is the process-local/LAN front end the load generator and clients
+speak to, mirroring how detection workers sit behind a real gateway.
+
+Endpoints::
+
+    POST /detect   {"image_b64": <base64 of an encoded PNG/JPEG>}
+                 | {"pixels_b64": <base64 raw uint8 RGB>, "shape": [h,w,3]}
+                   optional: "timeout_ms"
+                   → 200 {"detections": [{"class_id", "class", "score",
+                                          "box": [x1,y1,x2,y2]}, ...],
+                          "latency_ms", "batch_rows"}
+                   → 429 queue over watermark (shed)  — retry later
+                   → 504 deadline expired before serve
+                   → 400 malformed request, 500 engine failure
+    GET  /healthz  → 200 engine liveness + warmed-program inventory
+    GET  /metrics  → 200 metrics snapshot (serve/metrics.py)
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import logging
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+import numpy as np
+
+from mx_rcnn_tpu.serve.engine import ServingEngine
+from mx_rcnn_tpu.serve.queue import (DeadlineExceeded, RequestFailed,
+                                     ShedError)
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+
+def decode_image_payload(body: dict) -> np.ndarray:
+    """Request JSON → RGB uint8 (h, w, 3) array.  Two encodings: a
+    base64'd encoded image file (decoded cv2-first like
+    ``data/image.py — imread_rgb``) or base64'd raw pixels + shape."""
+    if "pixels_b64" in body:
+        shape = tuple(body.get("shape") or ())
+        if len(shape) != 3 or shape[2] != 3:
+            raise ValueError("pixels_b64 needs shape [h, w, 3]")
+        raw = base64.b64decode(body["pixels_b64"])
+        img = np.frombuffer(raw, np.uint8)
+        if img.size != int(np.prod(shape)):
+            raise ValueError(
+                f"pixels_b64 carries {img.size} bytes, shape asks "
+                f"{int(np.prod(shape))}")
+        return img.reshape(shape)
+    if "image_b64" in body:
+        raw = base64.b64decode(body["image_b64"])
+        try:
+            import cv2
+
+            img = cv2.imdecode(np.frombuffer(raw, np.uint8),
+                               cv2.IMREAD_COLOR)
+            if img is None:
+                raise ValueError("cv2 could not decode image_b64")
+            return img[:, :, ::-1]  # BGR → RGB, matching imread_rgb
+        except ImportError:  # pragma: no cover - cv2 is in the image
+            from PIL import Image
+
+            with Image.open(io.BytesIO(raw)) as im:
+                return np.asarray(im.convert("RGB"))
+    raise ValueError("request needs image_b64 or pixels_b64")
+
+
+def detections_to_json(dets, class_names: Optional[List[str]]) -> list:
+    """{class_id: (k, 5)} → the wire list, scores descending."""
+    out = []
+    for c, arr in sorted(dets.items()):
+        name = (class_names[c] if class_names and c < len(class_names)
+                else f"cls{c}")
+        for x1, y1, x2, y2, score in arr:
+            out.append({"class_id": int(c), "class": name,
+                        "score": round(float(score), 4),
+                        "box": [round(float(v), 2)
+                                for v in (x1, y1, x2, y2)]})
+    out.sort(key=lambda d: -d["score"])
+    return out
+
+
+class DetectionHandler(BaseHTTPRequestHandler):
+    # the server instance carries .engine / .class_names (see make_server)
+    protocol_version = "HTTP/1.1"
+
+    def _reply(self, status: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # route to the repo logger
+        logger.debug("serve http: " + fmt, *args)
+
+    def do_GET(self):
+        engine: ServingEngine = self.server.engine
+        if self.path == "/healthz":
+            h = engine.healthz()
+            self._reply(200 if h["ok"] else 503, h)
+        elif self.path == "/metrics":
+            self._reply(200, engine.metrics.snapshot())
+        else:
+            self._reply(404, {"error": f"no such path {self.path!r}"})
+
+    def do_POST(self):
+        if self.path != "/detect":
+            self._reply(404, {"error": f"no such path {self.path!r}"})
+            return
+        engine: ServingEngine = self.server.engine
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("request body must be a JSON object")
+            img = decode_image_payload(body)
+        except (ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        t0 = time.monotonic()
+        try:
+            # submit+wait (not engine.detect): the handle carries the
+            # batch_rows the response promises
+            req = engine.submit(img, timeout_ms=body.get("timeout_ms"))
+            wait_s = None
+            if req.deadline is not None:
+                wait_s = max(req.deadline - time.monotonic(), 0.0) + 30.0
+            dets = req.wait(timeout=wait_s)
+        except ShedError:
+            self._reply(429, {"error": "overloaded: request shed at "
+                                       "admission, retry later"})
+            return
+        except DeadlineExceeded:
+            self._reply(504, {"error": "deadline expired before serve"})
+            return
+        except (RequestFailed, TimeoutError) as e:
+            self._reply(500, {"error": str(e)})
+            return
+        self._reply(200, {
+            "detections": detections_to_json(dets,
+                                             self.server.class_names),
+            "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
+            "batch_rows": req.batch_rows,
+        })
+
+
+def make_server(engine: ServingEngine, host: str = "127.0.0.1",
+                port: int = 8080, class_names: List[str] = None
+                ) -> ThreadingHTTPServer:
+    """Build (not start) the HTTP server; ``port=0`` picks a free port
+    (read it back from ``server.server_address``)."""
+    srv = ThreadingHTTPServer((host, port), DetectionHandler)
+    srv.engine = engine
+    srv.class_names = list(class_names) if class_names else None
+    return srv

@@ -36,7 +36,9 @@ def detect_image(predictor: Predictor, img: np.ndarray, cfg: Config,
     postprocess semantics."""
     import jax.numpy as jnp
 
-    from mx_rcnn_tpu.core.tester import _postprocess_batch, tiled_bbox_stats
+    from mx_rcnn_tpu.core.tester import (_postprocess_batch,
+                                         detections_from_keep,
+                                         tiled_bbox_stats)
 
     data, im_scale, bucket = resize_to_bucket(
         img, cfg.network.pixel_means, cfg.bucket.scale, cfg.bucket.max_size,
@@ -51,16 +53,7 @@ def detect_image(predictor: Predictor, img: np.ndarray, cfg: Config,
         rois, roi_valid, cls_prob, deltas, jnp.asarray(im_info),
         jnp.asarray([im_scale], dtype=jnp.float32), stds, means,
         nms_thresh=cfg.test.nms, score_thresh=vis_thresh))
-    r = boxes_b.shape[1]
-    boxes = boxes_b[0].reshape(r, num_classes, 4)
-    out: Dict[int, np.ndarray] = {}
-    for c in range(1, num_classes):
-        keep = keep_b[0, c]
-        if keep.any():
-            out[c] = np.hstack([boxes[keep, c],
-                                scores_b[0][keep, c, None]]
-                               ).astype(np.float32)
-    return out
+    return detections_from_keep(boxes_b, scores_b, keep_b, 0)
 
 
 _COLORS = [(230, 60, 60), (60, 200, 80), (70, 110, 240), (240, 200, 50),

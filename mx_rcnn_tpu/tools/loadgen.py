@@ -1,0 +1,337 @@
+"""Load generator for the serving engine: closed/open loop, BENCH JSON.
+
+No reference equivalent.  Replays synthetic images against an IN-PROCESS
+:class:`~mx_rcnn_tpu.serve.engine.ServingEngine` (no network in the
+measurement path — the HTTP front end is exercised by its own tests) and
+emits ONE BENCH-style JSON line so serving performance enters the
+measured-evidence pipeline like `bench.py` does for training:
+
+    {"metric": "serve_imgs_per_sec", "value": ..., "measured": true,
+     "offline_imgs_per_sec": ..., "ratio_vs_offline": ...,
+     "p50_ms"/"p90_ms"/"p99_ms": ..., "shed_rate": ..., "lost": 0,
+     "recompiles_after_warmup": 0, ...}
+
+Two load models:
+
+* ``--mode closed`` — ``--concurrency`` workers each keep exactly one
+  request in flight (submit → wait → repeat): measures sustainable
+  throughput and in-system latency without overload.
+* ``--mode open``   — requests are submitted on a fixed ``--qps``
+  schedule regardless of completions (the arrival process real traffic
+  has): driving QPS past capacity exercises deadline expiry and the shed
+  watermark, and the emitted shed/expired rates show overload degrading
+  gracefully instead of collapsing latency.
+
+The offline baseline is the same Predictor forward + postprocess batch
+loop WITHOUT the serving machinery (queues, threads, per-request demux),
+at the identical bucket/batch size — ``ratio_vs_offline`` is the serving
+overhead acceptance metric (ISSUE 2: >= 0.8).  ``--check`` turns the
+invariants (zero lost requests, zero post-warmup recompiles, ratio
+floor) into the exit code for ``make serve-smoke``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+import time
+from typing import List
+
+import numpy as np
+
+from mx_rcnn_tpu.config import Config, generate_config
+from mx_rcnn_tpu.core.tester import Predictor
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.serve.engine import ServingEngine
+from mx_rcnn_tpu.serve.metrics import LoweringCounter
+from mx_rcnn_tpu.serve.queue import (DeadlineExceeded, RequestFailed,
+                                     ShedError)
+from mx_rcnn_tpu.tools.train import add_set_arg, parse_set_overrides
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+
+def synthetic_images(cfg: Config, n: int, seed: int = 0
+                     ) -> List[np.ndarray]:
+    """``n`` random uint8 RGB images alternating landscape/portrait at
+    the bucket canvas sizes, so mixed traffic exercises EVERY shape
+    bucket (the recompile guard is only meaningful over mixed shapes)."""
+    rng = np.random.RandomState(seed)
+    buckets = [tuple(b) for b in cfg.bucket.shapes]
+    return [rng.randint(0, 256,
+                        size=buckets[i % len(buckets)] + (3,),
+                        dtype=np.uint8)
+            for i in range(n)]
+
+
+def init_predictor(cfg: Config, prefix: str = None, epoch: int = 0,
+                   seed: int = 0) -> Predictor:
+    """Predictor from a checkpoint when given one, else from random init
+    — serving throughput does not depend on the weight values."""
+    import jax
+
+    from mx_rcnn_tpu.core.train import init_variables
+
+    model = build_model(cfg)
+    if prefix:
+        from mx_rcnn_tpu.utils.checkpoint import load_param
+
+        params, batch_stats = load_param(prefix, epoch)
+    else:
+        params, batch_stats = init_variables(
+            model, jax.random.PRNGKey(seed),
+            (1,) + tuple(cfg.bucket.shapes[0]) + (3,))
+    return Predictor(model, {"params": params, "batch_stats": batch_stats},
+                     cfg)
+
+
+def offline_rate(engine: ServingEngine, reps: int = 12) -> float:
+    """The comparison bar: full-batch Predictor forward + postprocess in
+    a plain loop, no serving machinery, same bucket/batch size.  Buckets
+    alternate like the serving traffic does."""
+    b = engine.cfg.serve.batch_size
+    batches = []
+    for bucket in engine.buckets:
+        bh, bw = bucket
+        images = np.zeros((b, bh, bw, 3), np.float32)
+        im_info = np.tile(np.array([bh, bw, 1.0], np.float32), (b, 1))
+        batches.append((images, im_info))
+    engine._run(*batches[0])  # ensure warm before timing
+    t0 = time.perf_counter()
+    for i in range(reps):
+        engine._run(*batches[i % len(batches)])
+    dt = time.perf_counter() - t0
+    return reps * b / dt
+
+
+def run_closed_loop(engine: ServingEngine, images, duration_s: float,
+                    concurrency: int, timeout_ms: float) -> dict:
+    """``concurrency`` workers, one request in flight each."""
+    stop = time.monotonic() + duration_s
+    outcomes = {"ok": 0, "shed": 0, "expired": 0, "failed": 0}
+    lock = threading.Lock()
+
+    def worker(wid: int):
+        i = wid
+        while time.monotonic() < stop:
+            img = images[i % len(images)]
+            i += concurrency
+            try:
+                engine.detect(img, timeout_ms=timeout_ms)
+                key = "ok"
+            except ShedError:
+                key = "shed"
+            except DeadlineExceeded:
+                key = "expired"
+            except (RequestFailed, TimeoutError):
+                key = "failed"
+            with lock:
+                outcomes[key] += 1
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return {"wall_s": time.perf_counter() - t0, "client": outcomes}
+
+
+def run_open_loop(engine: ServingEngine, images, duration_s: float,
+                  qps: float, timeout_ms: float) -> dict:
+    """Submit on a fixed schedule (no back-pressure from completions);
+    collect every handle so no request outcome is dropped."""
+    period = 1.0 / qps
+    handles = []
+    t0 = time.perf_counter()
+    start = time.monotonic()
+    k = 0
+    while True:
+        target = start + k * period
+        now = time.monotonic()
+        if now - start >= duration_s:
+            break
+        if now < target:
+            time.sleep(target - now)
+        handles.append(engine.submit(images[k % len(images)],
+                                     timeout_ms=timeout_ms))
+        k += 1
+    outcomes = {"ok": 0, "shed": 0, "expired": 0, "failed": 0}
+    for h in handles:
+        try:
+            h.wait(timeout=30.0)
+            outcomes["ok"] += 1
+        except ShedError:
+            outcomes["shed"] += 1
+        except DeadlineExceeded:
+            outcomes["expired"] += 1
+        except (RequestFailed, TimeoutError):
+            outcomes["failed"] += 1
+    return {"wall_s": time.perf_counter() - t0, "client": outcomes,
+            "submitted": k}
+
+
+def _smoke_overrides() -> dict:
+    """The `make serve-smoke` canvas: the quick-tier 128x160 tiny-model
+    buckets (compiles in seconds on one CPU core) with eval-scale ROI
+    counts shrunk to keep the smoke under a minute."""
+    return {
+        "bucket__scale": 128, "bucket__max_size": 160,
+        "bucket__shapes": ((128, 160), (160, 128)),
+        "test__rpn_pre_nms_top_n": 512, "test__rpn_post_nms_top_n": 64,
+        "serve__batch_size": 2, "serve__max_delay_ms": 20.0,
+    }
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    p = argparse.ArgumentParser(
+        description="Serving load generator + BENCH JSON "
+                    "(docs/SERVING.md)")
+    p.add_argument("--network", default="tiny",
+                   choices=["vgg", "resnet50", "resnet101", "tiny"])
+    p.add_argument("--dataset", default="synthetic",
+                   choices=["PascalVOC", "coco", "synthetic",
+                            "synthetic_hard"])
+    p.add_argument("--prefix", default=None,
+                   help="checkpoint prefix (default: random init — "
+                        "throughput does not depend on weights)")
+    p.add_argument("--epoch", type=int, default=0)
+    p.add_argument("--mode", default="closed", choices=["closed", "open"])
+    p.add_argument("--duration", type=float, default=20.0,
+                   help="measurement window, seconds")
+    p.add_argument("--concurrency", type=int, default=None,
+                   help="closed-loop workers (default: 2x batch_size — "
+                        "enough to keep every micro-batch full)")
+    p.add_argument("--qps", type=float, default=20.0,
+                   help="open-loop arrival rate")
+    p.add_argument("--timeout_ms", type=float, default=None,
+                   help="per-request deadline (default: "
+                        "cfg.serve.default_timeout_ms)")
+    p.add_argument("--images", type=int, default=16,
+                   help="distinct synthetic images to cycle through")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default=None,
+                   help="also write the JSON record to this path")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 unless: zero lost requests, zero "
+                        "post-warmup recompiles, ratio_vs_offline >= "
+                        "--min_ratio")
+    p.add_argument("--min_ratio", type=float, default=0.5,
+                   help="--check floor for serving/offline throughput "
+                        "(0.5 for the contended-smoke gate; the "
+                        "acceptance measurement in docs/SERVING.md "
+                        "records the real ratio)")
+    p.add_argument("--smoke", action="store_true",
+                   help="small-canvas preset for `make serve-smoke` "
+                        "(tiny net, 128x160 buckets, short window)")
+    add_set_arg(p)
+    args = p.parse_args(argv)
+
+    overrides = {}
+    if args.smoke:
+        overrides.update(_smoke_overrides())
+        args.duration = min(args.duration, 12.0)
+    overrides.update(parse_set_overrides(args))
+    cfg = generate_config(args.network, args.dataset, **overrides)
+    concurrency = args.concurrency or 2 * cfg.serve.batch_size
+    timeout_ms = (cfg.serve.default_timeout_ms if args.timeout_ms is None
+                  else args.timeout_ms)
+
+    predictor = init_predictor(cfg, args.prefix, args.epoch, args.seed)
+    engine = ServingEngine(predictor, cfg)
+    images = synthetic_images(cfg, args.images, args.seed)
+
+    logger.info("warmup: compiling %d bucket program(s) at batch %d ...",
+                len(engine.buckets), cfg.serve.batch_size)
+    t0 = time.perf_counter()
+    engine.warmup()
+    logger.info("warmup done in %.1fs", time.perf_counter() - t0)
+    logger.info("offline baseline (no serving machinery) ...")
+    off = offline_rate(engine)
+    logger.info("offline: %.2f imgs/s at batch %d", off,
+                cfg.serve.batch_size)
+
+    # fresh metrics for the measured window (warmup batches excluded)
+    engine.metrics.reset()
+    logger.info("load: mode=%s duration=%.0fs %s", args.mode,
+                args.duration,
+                f"concurrency={concurrency}" if args.mode == "closed"
+                else f"qps={args.qps}")
+    with LoweringCounter() as lc:
+        if args.mode == "closed":
+            run = run_closed_loop(engine, images, args.duration,
+                                  concurrency, timeout_ms)
+        else:
+            run = run_open_loop(engine, images, args.duration, args.qps,
+                                timeout_ms)
+        # drain: every submitted request must reach a terminal state
+        deadline = time.monotonic() + 30.0
+        while (engine.metrics.snapshot()["in_flight"] > 0
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+    snap = engine.metrics.snapshot()
+    engine.close()
+
+    c = snap["counters"]
+    lost = c["submitted"] - snap["terminated"]
+    served_rate = c["served"] / run["wall_s"]
+    rec = {
+        "metric": "serve_imgs_per_sec",
+        "value": round(served_rate, 2),
+        "unit": "imgs/s",
+        "measured": True,
+        "mode": args.mode,
+        "network": args.network,
+        "bucket_shapes": [list(b) for b in cfg.bucket.shapes],
+        "batch_size": cfg.serve.batch_size,
+        "max_delay_ms": cfg.serve.max_delay_ms,
+        "duration_s": round(run["wall_s"], 2),
+        "concurrency": concurrency if args.mode == "closed" else None,
+        "qps_target": args.qps if args.mode == "open" else None,
+        "offline_imgs_per_sec": round(off, 2),
+        "ratio_vs_offline": round(served_rate / off, 3) if off else None,
+        "p50_ms": snap["total_ms"]["p50"],
+        "p90_ms": snap["total_ms"]["p90"],
+        "p99_ms": snap["total_ms"]["p99"],
+        "queue_wait_p99_ms": snap["queue_wait_ms"]["p99"],
+        "model_ms_p50": snap["model_ms"]["p50"],
+        "batch_occupancy_mean": snap["batch_occupancy"]["mean_rows"],
+        "served": c["served"], "shed": c["shed"],
+        "expired": c["expired"], "failed": c["failed"],
+        "submitted": c["submitted"],
+        "shed_rate": round(c["shed"] / max(c["submitted"], 1), 4),
+        "expired_rate": round(c["expired"] / max(c["submitted"], 1), 4),
+        "lost": lost,
+        "recompiles_after_warmup": lc.n,
+        "client_outcomes": run["client"],
+    }
+    print(json.dumps(rec))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(rec, f, indent=1)
+    if args.check:
+        problems = []
+        if lost != 0:
+            problems.append(f"{lost} requests lost (no terminal state)")
+        if lc.n != 0:
+            problems.append(f"{lc.n} recompiles after warmup")
+        if rec["ratio_vs_offline"] is not None \
+                and rec["ratio_vs_offline"] < args.min_ratio:
+            problems.append(
+                f"serving/offline ratio {rec['ratio_vs_offline']} < "
+                f"{args.min_ratio}")
+        if c["served"] == 0:
+            problems.append("zero requests served")
+        for msg in problems:
+            logger.error("CHECK FAILED: %s", msg)
+        return 1 if problems else 0
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,83 @@
+"""Online detection serving entry point: checkpoint → warmed HTTP service.
+
+No reference equivalent (the reference has no online inference path).
+Builds the model from a training checkpoint, wraps it in the
+micro-batching :class:`~mx_rcnn_tpu.serve.engine.ServingEngine`,
+pre-compiles every shape-bucket program (so no client ever pays an XLA
+compile), and serves ``/detect`` / ``/healthz`` / ``/metrics`` over
+stdlib HTTP (``serve/server.py``).  Policy knobs live in
+``cfg.serve`` — override any of them with
+``--set serve__batch_size=8`` etc.  Architecture and measured numbers:
+``docs/SERVING.md``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.core.tester import Predictor
+from mx_rcnn_tpu.models import build_model
+from mx_rcnn_tpu.serve.engine import ServingEngine
+from mx_rcnn_tpu.serve.server import make_server
+from mx_rcnn_tpu.tools.train import add_set_arg, parse_set_overrides
+from mx_rcnn_tpu.utils.checkpoint import load_param
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        description="Serve a Faster R-CNN checkpoint over HTTP "
+                    "(docs/SERVING.md)")
+    p.add_argument("--network", default="resnet101",
+                   choices=["vgg", "resnet50", "resnet101", "tiny"])
+    p.add_argument("--dataset", default="PascalVOC",
+                   choices=["PascalVOC", "coco", "synthetic",
+                            "synthetic_hard"])
+    p.add_argument("--prefix", default="model/e2e")
+    p.add_argument("--epoch", type=int, required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--class_names", default=None,
+                   help="comma-separated class names (index 0 = "
+                        "background); default labels are cls<N>")
+    p.add_argument("--no_warmup", action="store_true",
+                   help="skip the startup pre-compile pass (first "
+                        "request per bucket then pays the compile)")
+    add_set_arg(p)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    args = parse_args(argv)
+    cfg = generate_config(args.network, args.dataset,
+                          **parse_set_overrides(args))
+    model = build_model(cfg)
+    params, batch_stats = load_param(args.prefix, args.epoch)
+    predictor = Predictor(
+        model, {"params": params, "batch_stats": batch_stats}, cfg)
+    engine = ServingEngine(predictor, cfg)
+    if not args.no_warmup:
+        logger.info("warming %d bucket(s) at batch %d ...",
+                    len(engine.buckets), cfg.serve.batch_size)
+        engine.warmup()
+    names = args.class_names.split(",") if args.class_names else None
+    srv = make_server(engine, args.host, args.port, class_names=names)
+    host, port = srv.server_address[:2]
+    logger.info("serving on http://%s:%d  (POST /detect, GET /healthz, "
+                "GET /metrics)", host, port)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        logger.info("shutting down")
+    finally:
+        srv.server_close()
+        engine.close()
+
+
+if __name__ == "__main__":
+    main()

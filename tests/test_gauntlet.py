@@ -182,6 +182,43 @@ def test_gauntlet_pinned_seed0_regression_gate(tmp_path):
     assert rec["mAP"] >= GATE_FLOOR, rec
 
 
+@pytest.mark.slow
+@pytest.mark.gate
+def test_paired_gate_fires_on_damaged_arm(tmp_path):
+    """Red-team of the --compare gate (VERDICT r5 weak #4): the FAIL
+    direction had only ever been exercised on fabricated records.  Here
+    one arm is DELIBERATELY damaged (redteam mode: eval NMS 0.9 floods
+    the AP sweep with surviving duplicates) and the gate must fire on
+    the real training pair: exit 1, with every per-seed delta decisively
+    negative.  The recorded plain-env run of the same recipe is
+    docs/gauntlet_redteam.json (docs/GAUNTLET.md "Red-team")."""
+    import io
+    from contextlib import redirect_stdout
+
+    from mx_rcnn_tpu.tools.gauntlet import main as gauntlet_main
+
+    out = tmp_path / "results.json"
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = gauntlet_main([
+            "--root", str(tmp_path), "--workdir", str(tmp_path / "w"),
+            "--out", str(out), "--network", "tiny",
+            "--seeds", "0", "1", "--epochs", "4", "--lr", "3e-3",
+            "--lr_step", "3", "--compare", "e2e", "redteam"])
+    assert rc == 1, "gate FAIL direction did not fire on a damaged arm"
+    cmp = [json.loads(line) for line in buf.getvalue().splitlines()
+           if '"compare"' in line][-1]
+    assert cmp["compare"] == "redteam-vs-e2e"
+    # the damage is not subtle: every seed must lose well past the budget
+    assert all(d < -cmp["budget"] for d in cmp["deltas"]), cmp
+    assert cmp["mean_delta"] < -0.05, cmp
+    assert cmp["within_budget"] is False
+    # and the damaged arm is labelled as such in its records
+    recs = json.loads(out.read_text())
+    assert all(r["damage"] == "test__nms=0.9" for r in recs
+               if r["mode"] == "redteam")
+
+
 def test_easy_dataset_unchanged(tmp_path):
     """The hard subclass must not perturb the easy set's generation (its
     pinned expectations elsewhere depend on byte-identical specs)."""

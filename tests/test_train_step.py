@@ -228,6 +228,9 @@ def test_bf16_momentum_state_and_training():
                          rpn_post_nms_top_n=64, batch_rois=32,
                          max_gt_boxes=8, rpn_min_size=2)
     model = build_model(cfg)
+    # both arms explicit: the shipped DEFAULT is bfloat16 (adopted from the
+    # r5 A/B — docs/PERF.md), so the fp32 arm must be requested
+    cfg = cfg.replace_in("default", momentum_dtype="float32")
     cfg16 = cfg.replace_in("default", momentum_dtype="bfloat16")
     batch = make_batch(1, 128, seed=5)
 
