@@ -13,7 +13,7 @@ NATIVE_DIR := mx_rcnn_tpu/native
 NATIVE_LIB := $(NATIVE_DIR)/libmxrcnn_native.so
 NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 
-.PHONY: all native lint test test-all test-gate serve-smoke clean
+.PHONY: all native lint test test-all test-gate serve-smoke ft-smoke clean
 
 all: native
 
@@ -50,12 +50,22 @@ test-all:
 serve-smoke:
 	python -m mx_rcnn_tpu.tools.loadgen --smoke --check
 
+# fault-tolerance smoke (docs/FT.md): a 2-kill crash loop on the tiny
+# model with synthetic data — one SIGTERM through the preemption path,
+# one torn-write + SIGKILL — auto-resumed via the integrity scanner;
+# fails unless every kill is survived and the survivor's final
+# TrainState is BIT-IDENTICAL to an uninterrupted control run.  ~2 min
+# warm on this box (subprocess restarts share the XLA compile cache).
+ft-smoke:
+	python -m mx_rcnn_tpu.tools.crashloop --smoke --check --skip_overhead
+
 # the two end-metric gates (30-epoch gauntlet seed-0 from scratch
 # ~22 min, 16-device hierarchical dryrun ~7 min on one core) — run
 # these for round-gate evidence; test-all stays green without them.
 # graphlint runs first: a hygiene violation fails the gate in seconds
-# instead of after 30 minutes of training; serve-smoke next (~30 s)
-test-gate: lint serve-smoke
+# instead of after 30 minutes of training; serve-smoke next (~30 s),
+# then the 2-kill crash loop (ft-smoke, ~2 min)
+test-gate: lint serve-smoke ft-smoke
 	python -m pytest tests/ -x -q -m "gate"
 
 clean:

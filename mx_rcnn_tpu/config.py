@@ -247,6 +247,35 @@ class ServeConfig:
 
 
 @dataclass(frozen=True)
+class FTConfig:
+    """TPU addition (no reference equivalent — the reference dies on
+    preemption and restarts at the last epoch boundary): policy knobs for
+    the ``mx_rcnn_tpu/ft/`` fault-tolerance layer (docs/FT.md).
+
+    Same 3-level precedence as every section (hardcoded defaults <
+    presets < ``--set ft__field=value`` CLI overrides).
+    """
+
+    # serialize+write+fsync checkpoints on a background writer thread so
+    # the training step only pays the device_get; False restores the
+    # fully synchronous write-on-the-training-thread path
+    async_snapshots: bool = True
+    # the writer admits one snapshot being written + one queued (at most
+    # two fetched host copies alive); the request that would make a third
+    # blocks up to this long, then fails loudly (never an unbounded
+    # backlog of multi-hundred-MB serializations)
+    slot_timeout_s: float = 120.0
+    # retention GC (ft/integrity.py — gc_checkpoints): keep the newest
+    # keep_last epoch checkpoints plus every keep_every-th epoch.  The
+    # DEFAULT keep_every=1 marks every epoch as a keeper, i.e. nothing is
+    # ever deleted — reference parity (the reference keeps all per-epoch
+    # params files); raise it (e.g. ``--set ft__keep_every=5``) to thin
+    # long runs.  keep_last=0 disables GC entirely.
+    keep_last: int = 3
+    keep_every: int = 1
+
+
+@dataclass(frozen=True)
 class Config:
     train: TrainConfig = field(default_factory=TrainConfig)
     test: TestConfig = field(default_factory=TestConfig)
@@ -255,6 +284,7 @@ class Config:
     default: DefaultConfig = field(default_factory=DefaultConfig)
     bucket: BucketConfig = field(default_factory=BucketConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    ft: FTConfig = field(default_factory=FTConfig)
 
     @property
     def num_classes(self) -> int:

@@ -23,8 +23,6 @@ import numpy as np
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.core.train import Batch, TrainState, make_train_step
 from mx_rcnn_tpu.models.faster_rcnn import FasterRCNN
-from mx_rcnn_tpu.utils.checkpoint import (clear_interrupt, save_checkpoint,
-                                          save_interrupt)
 
 logger = logging.getLogger("mx_rcnn_tpu")
 
@@ -92,6 +90,7 @@ def fit(
     profile_dir: Optional[str] = None,
     stop_flag: Optional[Callable[[], bool]] = None,
     device_cache: bool = False,
+    step_callback: Optional[Callable[[int], None]] = None,
 ) -> TrainState:
     """Run ``begin_epoch .. num_epochs`` epochs; checkpoint per epoch.
 
@@ -108,6 +107,14 @@ def fit(
     ``stop_flag``: polled after every step; when it returns True the loop
     saves a mid-epoch interrupt checkpoint (``<prefix>-interrupt.ckpt``)
     and returns — the preemption path (SIGTERM on preemptible TPUs).
+    ``step_callback``: host-side hook called with the global step after
+    every executed step (fault injection — ``ft/faults.py`` — and test
+    instrumentation; adds no device sync).
+    Checkpoints go through the ``ft/snapshot.py`` snapshotter: the
+    training thread pays only the ``jax.device_get``; serialization +
+    durable write + manifest commit + retention GC happen on a background
+    writer thread (``cfg.ft.async_snapshots=false`` restores inline
+    writes).  The interrupt save is flushed before the loop returns.
     ``device_cache``: stage the loader's epoch in HBM once and gather each
     step's batch on device (``data/device_cache.py``) — for RAM/HBM-scale
     datasets on hosts or links too slow to stream per step.  Shuffling is
@@ -161,8 +168,14 @@ def fit(
                     cache.num_batches, shuffle=shuffle),
                 donate_argnums=(0, 2))
         # the gather index IS the global step: restores (incl. mid-epoch
-        # interrupts) resume the exact batch sequence with no bookkeeping
-        idx_box = [jnp.asarray(jax.device_get(state.step), jnp.int32)]
+        # interrupts) resume the exact batch sequence with no bookkeeping.
+        # int() is LOAD-BEARING: on CPU, device_get returns a zero-copy
+        # view of the step buffer and jnp.asarray keeps sharing it — the
+        # idx would alias state.step, and cstep donates BOTH (argnums 0
+        # and 2), double-donating one buffer → nondeterministic training
+        # (found by the ft crashloop; pinned by
+        # test_cached_fit_is_deterministic in tests/test_ft.py)
+        idx_box = [jnp.asarray(int(jax.device_get(state.step)), jnp.int32)]
 
         def run_step(state, batch: Batch):
             state, idx_box[0], metrics = cstep(state, cache.data,
@@ -188,101 +201,120 @@ def fit(
     speedo = Speedometer(cfg.train.batch_images * n_dev, frequent)
     steps_per_epoch = len(train_loader)
     done_steps = int(jax.device_get(state.step))
-    for epoch in range(begin_epoch, num_epochs):
-        if hasattr(train_loader, "set_epoch"):
-            train_loader.set_epoch(epoch)  # resume-exact shuffle order
-        # mid-epoch (preemption) resume: skip batches the restored state
-        # already consumed; the deterministic shuffle replays the same order
-        skip = 0
-        if epoch == begin_epoch and steps_per_epoch:
-            skip = min(max(done_steps - epoch * steps_per_epoch, 0),
-                       steps_per_epoch)
-            if skip:
-                logger.info("Epoch[%d] resuming mid-epoch: skipping %d "
-                            "consumed batches", epoch, skip)
-        speedo.reset()
-        window: List[Dict] = []
-        epoch_metrics: List[Dict] = []
-        t0 = time.perf_counter()
-        nbatch = skip
-        tracing = False
-        stop_requested = False
-        if cache is not None:
-            # batches gather on device from the staged epoch; the resumed
-            # idx (== state.step) already accounts for the skipped prefix
-            batch_iter = iter([None] * (steps_per_epoch - skip))
-        else:
-            loader_skips = hasattr(train_loader, "skip_next_batches")
-            if skip and loader_skips:
-                train_loader.skip_next_batches(skip)  # trims the order list
-            batch_iter = iter(train_loader)
-            if skip and not loader_skips:
-                for _ in range(skip):  # fallback: decode-and-discard
-                    next(batch_iter, None)
-        for batch in batch_iter:
-            # trace steps [skip+2, skip+5) of the first epoch: the first two
-            # executed steps carry compile
-            if (profile_dir is not None and epoch == begin_epoch
-                    and nbatch == skip + 2):
-                jax.profiler.start_trace(profile_dir)
-                tracing = True
-                logger.info("profiler trace started -> %s", profile_dir)
-            state, metrics = run_step(state, batch)
-            window.append(metrics)
-            nbatch += 1
-            if tracing and nbatch >= skip + 5:
+    snap = None
+    if prefix is not None:
+        from mx_rcnn_tpu.ft.snapshot import make_snapshotter
+
+        snap = make_snapshotter(prefix, cfg, steps_per_epoch)
+    try:
+        for epoch in range(begin_epoch, num_epochs):
+            if hasattr(train_loader, "set_epoch"):
+                train_loader.set_epoch(epoch)  # resume-exact shuffle order
+            # mid-epoch (preemption) resume: skip batches the restored state
+            # already consumed; the deterministic shuffle replays the same
+            # order
+            skip = 0
+            if epoch == begin_epoch and steps_per_epoch:
+                skip = min(max(done_steps - epoch * steps_per_epoch, 0),
+                           steps_per_epoch)
+                if skip:
+                    logger.info("Epoch[%d] resuming mid-epoch: skipping %d "
+                                "consumed batches", epoch, skip)
+            speedo.reset()
+            window: List[Dict] = []
+            epoch_metrics: List[Dict] = []
+            t0 = time.perf_counter()
+            nbatch = skip
+            tracing = False
+            stop_requested = False
+            if cache is not None:
+                # batches gather on device from the staged epoch; the
+                # resumed idx (== state.step) already accounts for the
+                # skipped prefix
+                batch_iter = iter([None] * (steps_per_epoch - skip))
+            else:
+                loader_skips = hasattr(train_loader, "skip_next_batches")
+                if skip and loader_skips:
+                    train_loader.skip_next_batches(skip)  # trims the order
+                batch_iter = iter(train_loader)
+                if skip and not loader_skips:
+                    for _ in range(skip):  # fallback: decode-and-discard
+                        next(batch_iter, None)
+            for batch in batch_iter:
+                # trace steps [skip+2, skip+5) of the first epoch: the first
+                # two executed steps carry compile
+                if (profile_dir is not None and epoch == begin_epoch
+                        and nbatch == skip + 2):
+                    jax.profiler.start_trace(profile_dir)
+                    tracing = True
+                    logger.info("profiler trace started -> %s", profile_dir)
+                state, metrics = run_step(state, batch)
+                window.append(metrics)
+                nbatch += 1
+                if tracing and nbatch >= skip + 5:
+                    jax.block_until_ready(metrics)
+                    jax.profiler.stop_trace()
+                    tracing = False
+                    logger.info("profiler trace written to %s", profile_dir)
+                if step_callback is not None:
+                    step_callback(epoch * steps_per_epoch + nbatch)
+                if stop_flag is not None and stop_flag():
+                    stop_requested = True
+                    # mid-epoch: save the step-exact interrupt state and
+                    # leave.  On the epoch's LAST batch, fall through
+                    # instead — the normal epoch end writes the
+                    # (superseding) epoch checkpoint and the run stops
+                    # cleanly at the boundary.
+                    if nbatch < steps_per_epoch:
+                        if tracing:
+                            jax.profiler.stop_trace()
+                        if snap is not None:
+                            path = snap.save_interrupt(state)
+                            logger.info(
+                                "stop requested: saved interrupt checkpoint "
+                                'to "%s" (step %d) — rerun with --resume to '
+                                "continue", path,
+                                int(jax.device_get(state.step)))
+                        else:
+                            logger.info(
+                                "stop requested: no prefix, state not saved")
+                        return state
+                if nbatch % frequent == 0:
+                    avg = _mean_metrics(window)
+                    epoch_metrics.append(avg)
+                    window = []
+                    speedo(epoch, nbatch, avg)
+                else:
+                    speedo(epoch, nbatch, {})
+            if tracing:  # epoch shorter than the trace window
                 jax.block_until_ready(metrics)
                 jax.profiler.stop_trace()
-                tracing = False
                 logger.info("profiler trace written to %s", profile_dir)
-            if stop_flag is not None and stop_flag():
-                stop_requested = True
-                # mid-epoch: save the step-exact interrupt state and leave.
-                # On the epoch's LAST batch, fall through instead — the
-                # normal epoch end writes the (superseding) epoch checkpoint
-                # and the run stops cleanly at the boundary.
-                if nbatch < steps_per_epoch:
-                    if tracing:
-                        jax.profiler.stop_trace()
-                    if prefix is not None:
-                        path = save_interrupt(prefix, state, steps_per_epoch)
-                        logger.info(
-                            "stop requested: saved interrupt checkpoint to "
-                            '"%s" (step %d) — rerun with --resume to '
-                            "continue", path,
-                            int(jax.device_get(state.step)))
-                    else:
-                        logger.info(
-                            "stop requested: no prefix, state not saved")
-                    return state
-            if nbatch % frequent == 0:
-                avg = _mean_metrics(window)
-                epoch_metrics.append(avg)
-                window = []
-                speedo(epoch, nbatch, avg)
-            else:
-                speedo(epoch, nbatch, {})
-        if tracing:  # epoch shorter than the trace window
-            jax.block_until_ready(metrics)
-            jax.profiler.stop_trace()
-            logger.info("profiler trace written to %s", profile_dir)
-        if window:
-            epoch_metrics.append(_mean_metrics(window))
-        if epoch_metrics:
-            keys = epoch_metrics[0].keys()
-            summary = ", ".join(
-                f"{k}={np.mean([m[k] for m in epoch_metrics]):.4f}"
-                for k in keys)
-            logger.info("Epoch[%d] Train summary: %s  (%.1fs)", epoch,
-                        summary, time.perf_counter() - t0)
-        if prefix is not None:
-            path = save_checkpoint(prefix, epoch + 1, state)
-            logger.info('Epoch[%d] Saved checkpoint to "%s"', epoch, path)
-            clear_interrupt(prefix)  # the epoch checkpoint supersedes it
-        if epoch_end_callback is not None:
-            epoch_end_callback(epoch, state)
-        if stop_requested:
-            logger.info("stop requested at epoch boundary — stopping after "
-                        "epoch %d", epoch)
-            return state
-    return state
+            if window:
+                epoch_metrics.append(_mean_metrics(window))
+            if epoch_metrics:
+                keys = epoch_metrics[0].keys()
+                summary = ", ".join(
+                    f"{k}={np.mean([m[k] for m in epoch_metrics]):.4f}"
+                    for k in keys)
+                logger.info("Epoch[%d] Train summary: %s  (%.1fs)", epoch,
+                            summary, time.perf_counter() - t0)
+            if snap is not None:
+                # device_get here, serialize+write+manifest+GC in the
+                # background; the interrupt file is cleared by the writer
+                # only after this epoch checkpoint commits
+                path = snap.save_epoch(epoch + 1, state)
+                logger.info('Epoch[%d] Snapshotting checkpoint to "%s"',
+                            epoch, path)
+            if epoch_end_callback is not None:
+                if snap is not None:
+                    snap.flush()  # callbacks may read the checkpoint file
+                epoch_end_callback(epoch, state)
+            if stop_requested:
+                logger.info("stop requested at epoch boundary — stopping "
+                            "after epoch %d", epoch)
+                return state
+        return state
+    finally:
+        if snap is not None:
+            snap.close()  # flush pending writes before the process moves on

@@ -1,0 +1,30 @@
+"""Fault-tolerant training (docs/FT.md) — survive SIGTERM, SIGKILL and
+torn writes without losing work, and PROVE it (ISSUE 3).
+
+Layers, bottom-up:
+
+* ``snapshot.py``  — async snapshotter: the training thread pays only the
+  ``jax.device_get``; serialization + atomic write + fsync + manifest
+  commit run on a single background writer thread with a bounded
+  in-flight slot;
+* ``integrity.py`` — restore-side verification: ``latest_valid_checkpoint``
+  scans newest→oldest, verifies manifests + SHA-256, falls back past
+  corrupt/truncated/manifest-less files; retention GC;
+* ``faults.py``    — deterministic fault injection (kill / truncate /
+  flip-byte / stale-interrupt) the training process executes against
+  itself;
+* ``supervisor.py`` — the crash-loop driver: kill ``tools/train.py`` M
+  times, auto-resume, verify the survivor is BIT-IDENTICAL to an
+  uninterrupted control run.
+
+Entry point: ``python -m mx_rcnn_tpu.tools.crashloop`` (BENCH-style JSON
+record → ``docs/ft_crashloop.json``).
+"""
+
+from mx_rcnn_tpu.ft.faults import Fault, FaultInjector, parse_plan  # noqa: F401
+from mx_rcnn_tpu.ft.integrity import (CheckpointRef,  # noqa: F401
+                                      gc_checkpoints,
+                                      latest_valid_checkpoint,
+                                      retention_keep_set, verify_checkpoint)
+from mx_rcnn_tpu.ft.snapshot import (AsyncSnapshotter,  # noqa: F401
+                                     SyncSnapshotter, make_snapshotter)

@@ -1,0 +1,150 @@
+"""Restore-side checkpoint verification + retention GC.
+
+The failure this layer exists for: a host dies mid-write (or a byte rots)
+and the NEWEST checkpoint file is garbage.  Before this layer, restore
+crashed on the first bad file and a human had to triage; now
+``latest_valid_checkpoint`` scans candidates newest→oldest, verifies each
+against its commit-point manifest (present + parseable + per-file SHA-256
+match), logs loudly for every file it falls back past, and returns the
+newest checkpoint that is actually restorable.  Work lost is bounded by
+the snapshot cadence, not by luck.
+
+Candidate ordering is by MANIFEST STEP, not filename: a stale interrupt
+file (left behind when a crash lands between the epoch-checkpoint commit
+and ``clear_interrupt``) records an older step than the epoch checkpoint
+that superseded it, so the scanner prefers the epoch file — the
+``stale-interrupt`` fault in ``faults.py`` exercises exactly this.
+
+Retention GC (``gc_checkpoints``) keeps the newest ``keep_last`` epoch
+checkpoints plus every ``keep_every``-th epoch (long-horizon anchors for
+rollback/debugging); it runs on the snapshot writer thread after each
+epoch commit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+from typing import NamedTuple, Optional, Sequence, Set, Tuple
+
+from mx_rcnn_tpu.utils.checkpoint import (interrupt_path, list_checkpoints,
+                                          manifest_path, read_manifest)
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+
+class CheckpointRef(NamedTuple):
+    """One verified (or candidate) checkpoint on disk."""
+
+    kind: str            # 'epoch' | 'interrupt'
+    path: str
+    step: int            # from the manifest
+    epoch: Optional[int]  # epoch number for kind='epoch', else None
+    manifest: dict
+
+
+def verify_checkpoint(path: str) -> Tuple[bool, str]:
+    """(ok, reason).  A checkpoint is valid iff its manifest exists, parses,
+    and every listed file matches its recorded size and SHA-256 — which
+    catches truncation, bit flips, and uncommitted (manifest-less) writes
+    without deserializing the payload."""
+    manifest = read_manifest(path)
+    if manifest is None:
+        return False, "no manifest (uncommitted or pre-manifest checkpoint)"
+    files = manifest.get("files") or {}
+    if not files:
+        return False, "manifest lists no files"
+    d = os.path.dirname(path) or "."
+    for name, meta in files.items():
+        fpath = os.path.join(d, name)
+        try:
+            with open(fpath, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            return False, f"{name}: unreadable ({e})"
+        if len(data) != meta.get("bytes"):
+            return False, (f"{name}: size {len(data)} != manifest "
+                           f"{meta.get('bytes')} (truncated?)")
+        digest = hashlib.sha256(data).hexdigest()
+        if digest != meta.get("sha256"):
+            return False, f"{name}: sha256 mismatch (corrupt)"
+    return True, "ok"
+
+
+def scan_candidates(prefix: str) -> Tuple[CheckpointRef, ...]:
+    """All restore candidates under ``prefix``, best-first: ordered by
+    manifest step descending, epoch checkpoints preferred over an
+    interrupt at the same step (at a boundary they encode the same state
+    and the epoch file is the durable one).  Files without a readable
+    manifest sort last (step -1) so they are only reported, never
+    preferred."""
+    cands = []
+    for epoch, path in list_checkpoints(prefix):
+        m = read_manifest(path)
+        cands.append(CheckpointRef(
+            "epoch", path, int(m["step"]) if m and "step" in m else -1,
+            epoch, m or {}))
+    ipath = interrupt_path(prefix)
+    if os.path.exists(ipath):
+        m = read_manifest(ipath)
+        cands.append(CheckpointRef(
+            "interrupt", ipath, int(m["step"]) if m and "step" in m else -1,
+            None, m or {}))
+    # interrupt wins step ties=False: sort key ranks epoch (1) above
+    # interrupt (0) at equal step
+    cands.sort(key=lambda c: (c.step, 1 if c.kind == "epoch" else 0,
+                              c.epoch if c.epoch is not None else -1),
+               reverse=True)
+    return tuple(cands)
+
+
+def latest_valid_checkpoint(prefix: str) -> Optional[CheckpointRef]:
+    """The newest checkpoint under ``prefix`` that verifies clean, falling
+    back past invalid candidates with a WARNING per skip (the loud part:
+    losing a snapshot must be visible in the log, not silent).  None if
+    nothing under ``prefix`` is restorable."""
+    for cand in scan_candidates(prefix):
+        ok, reason = verify_checkpoint(cand.path)
+        if ok:
+            return cand
+        logger.warning(
+            "checkpoint integrity: SKIPPING %s (%s) — falling back to the "
+            "next-newest candidate", cand.path, reason)
+    return None
+
+
+def retention_keep_set(epochs: Sequence[int], keep_last: int,
+                       keep_every: int) -> Set[int]:
+    """Which epochs retention keeps: the newest ``keep_last`` plus every
+    ``keep_every``-th (1-based epoch numbers divisible by ``keep_every``);
+    ``keep_every=0`` disables the long-horizon anchors."""
+    epochs = sorted(epochs)
+    keep = set(epochs[-keep_last:]) if keep_last else set()
+    if keep_every:
+        keep.update(e for e in epochs if e % keep_every == 0)
+    return keep
+
+
+def gc_checkpoints(prefix: str, keep_last: int = 3,
+                   keep_every: int = 5) -> Tuple[str, ...]:
+    """Delete epoch checkpoints outside the retention keep-set; returns the
+    deleted data-file paths.  Manifests go first (uncommit before unlink,
+    same ordering as ``clear_interrupt``)."""
+    found = list_checkpoints(prefix)
+    keep = retention_keep_set([e for e, _ in found], keep_last, keep_every)
+    deleted = []
+    for epoch, path in found:
+        if epoch in keep:
+            continue
+        for p in (manifest_path(path), path):
+            try:
+                os.unlink(p)
+            except FileNotFoundError:
+                pass
+        deleted.append(path)
+    if deleted:
+        logger.info("retention GC: dropped %d checkpoint(s) under %s "
+                    "(keep_last=%d, keep_every=%d)", len(deleted), prefix,
+                    keep_last, keep_every)
+    return tuple(deleted)

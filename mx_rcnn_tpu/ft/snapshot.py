@@ -1,0 +1,255 @@
+"""Async snapshotter: checkpoints stop stalling the training step.
+
+``utils/checkpoint.py — save_checkpoint`` runs device_get + msgpack +
+write + fsync on the calling thread — at ResNet-101 scale that is
+hundreds of MB of serialization the step pipeline stalls behind every
+epoch.  The snapshotter splits the save at its natural seam:
+
+* training thread: ``jax.device_get`` only (the state must be fetched
+  before the step donates/overwrites its buffers — that part is
+  irreducible), then enqueue;
+* ONE background writer thread: serialize → atomic write (tmp → fsync →
+  replace → dirsync) → manifest (the commit point) → retention GC.
+
+The in-flight window is BOUNDED: one snapshot being written plus one
+queued (at most TWO fetched host copies alive); the request that would
+make a third blocks up to ``ft.slot_timeout_s`` and then fails loudly —
+snapshots can lag the step, they can never pile up into an unbounded
+backlog of host copies.  Writer-thread failures are captured
+and re-raised on the training thread at the next snapshot or ``flush()``
+so a dying disk cannot silently disable checkpointing.
+
+``SyncSnapshotter`` is the same interface written synchronously
+(``ft.async_snapshots=false``) — one code path in ``core/fit.py`` either
+way, and the async-written file is bit-identical to the sync one (pinned
+by ``tests/test_ft.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+
+from mx_rcnn_tpu.utils.checkpoint import (checkpoint_path, clear_interrupt,
+                                          commit_checkpoint,
+                                          config_fingerprint, interrupt_path,
+                                          serialize_interrupt,
+                                          serialize_state)
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+
+def fetch_owned(state):
+    """``jax.device_get`` + force-OWN the memory.  On CPU backends
+    device_get returns zero-copy numpy VIEWS of the device buffers; the
+    next (donating) train step overwrites those buffers while the writer
+    thread is still serializing — the snapshot would capture torn garbage.
+    An explicit copy is a memcpy, orders of magnitude cheaper than the
+    serialization it protects (and a no-op semantically on accelerators,
+    where device_get already materializes an owned host array)."""
+    return jax.tree.map(lambda x: np.array(x, copy=True),
+                        jax.device_get(state))
+
+
+class SnapshotError(RuntimeError):
+    """A snapshot could not be taken (writer dead, slot timeout, or a
+    previous background write failed)."""
+
+
+class _Job:
+    """One queued write: already-fetched host state + commit metadata."""
+
+    def __init__(self, kind: str, path: str, host_state, epoch: Optional[int],
+                 steps_per_epoch: Optional[int], config_fp: Optional[str],
+                 clear_interrupt_after: bool, gc_fn=None):
+        self.kind = kind
+        self.path = path
+        self.host_state = host_state
+        self.epoch = epoch
+        self.steps_per_epoch = steps_per_epoch
+        self.config_fp = config_fp
+        self.clear_interrupt_after = clear_interrupt_after
+        self.gc_fn = gc_fn
+
+
+def _write_job(job: _Job, prefix: str) -> str:
+    """Serialize + commit one snapshot (runs on the writer thread for the
+    async snapshotter, inline for the sync one — shared so the bytes on
+    disk cannot depend on which mode wrote them)."""
+    if job.kind == "interrupt":
+        data = serialize_interrupt(job.host_state, job.steps_per_epoch)
+        step = int(job.host_state.step)
+    else:
+        data = serialize_state(job.host_state)
+        step = int(job.host_state.step)
+    commit_checkpoint(job.path, data, kind=job.kind, step=step,
+                      epoch=job.epoch, steps_per_epoch=job.steps_per_epoch,
+                      config_fp=job.config_fp)
+    if job.clear_interrupt_after:
+        # only AFTER the epoch checkpoint is committed — the interrupt
+        # file must stay restorable until its superseder is durable
+        clear_interrupt(prefix)
+    if job.gc_fn is not None:
+        job.gc_fn()
+    return job.path
+
+
+class _SnapshotterBase:
+    """Shared job construction for the async and sync snapshotters — one
+    place builds the commit metadata, so the bytes and manifests on disk
+    cannot depend on which mode wrote them.
+
+    ``cfg`` supplies the config fingerprint recorded in every manifest and
+    the retention-GC policy; ``steps_per_epoch`` is recorded in interrupt
+    manifests (step-exact resume validity check).
+    """
+
+    def __init__(self, prefix: str, cfg=None,
+                 steps_per_epoch: Optional[int] = None):
+        self.prefix = prefix
+        self.cfg = cfg
+        self.steps_per_epoch = steps_per_epoch
+        self.config_fp = config_fingerprint(cfg) if cfg is not None else None
+
+    def _gc_fn(self):
+        if self.cfg is None or not self.cfg.ft.keep_last:
+            return None
+        from mx_rcnn_tpu.ft.integrity import gc_checkpoints
+
+        cfg, prefix = self.cfg, self.prefix
+        return lambda: gc_checkpoints(prefix, keep_last=cfg.ft.keep_last,
+                                      keep_every=cfg.ft.keep_every)
+
+    def _epoch_job(self, epoch: int, state) -> _Job:
+        return _Job("epoch", checkpoint_path(self.prefix, epoch),
+                    fetch_owned(state), epoch, self.steps_per_epoch,
+                    self.config_fp, clear_interrupt_after=True,
+                    gc_fn=self._gc_fn())
+
+    def _interrupt_job(self, state) -> _Job:
+        return _Job("interrupt", interrupt_path(self.prefix),
+                    fetch_owned(state), None, self.steps_per_epoch,
+                    self.config_fp, clear_interrupt_after=False)
+
+
+class AsyncSnapshotter(_SnapshotterBase):
+    """Background-written, manifest-committed snapshots under ``prefix``."""
+
+    def __init__(self, prefix: str, cfg=None,
+                 steps_per_epoch: Optional[int] = None,
+                 slot_timeout_s: Optional[float] = None):
+        super().__init__(prefix, cfg, steps_per_epoch)
+        self.slot_timeout_s = float(
+            slot_timeout_s if slot_timeout_s is not None
+            else (cfg.ft.slot_timeout_s if cfg is not None else 120.0))
+        # the bounded in-flight window: ONE job being written + ONE queued
+        # (so at most TWO fetched host copies are alive); the request that
+        # would make a third blocks up to slot_timeout_s, then fails
+        # loudly — backpressure instead of an unbounded copy backlog.
+        self._q: "queue.Queue[Optional[_Job]]" = queue.Queue(maxsize=1)
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._thread = threading.Thread(target=self._writer_loop,
+                                        name="ft-snapshot-writer",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- writer thread ------------------------------------------------------
+    def _writer_loop(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is None:
+                self._q.task_done()
+                return
+            try:
+                path = _write_job(job, self.prefix)
+                logger.info("snapshot committed: %s (step %d, background)",
+                            path, int(job.host_state.step))
+            except BaseException as e:  # noqa: BLE001 — surfaced on train thread
+                logger.error("background snapshot write FAILED: %s", e)
+                self._error = e
+            finally:
+                self._q.task_done()
+
+    # -- training thread ----------------------------------------------------
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise SnapshotError(
+                f"a previous background snapshot write failed: {err!r}"
+            ) from err
+
+    def _submit(self, job: _Job) -> str:
+        self._raise_pending()
+        if self._closed or not self._thread.is_alive():
+            raise SnapshotError("snapshotter is closed or its writer died")
+        try:
+            self._q.put(job, timeout=self.slot_timeout_s)
+        except queue.Full:
+            raise SnapshotError(
+                f"snapshot writer still busy after {self.slot_timeout_s:.0f}s "
+                f"— disk cannot keep up with the snapshot cadence") from None
+        return job.path
+
+    def save_epoch(self, epoch: int, state) -> str:
+        """Fetch ``state`` to host (cheap, on this thread) and hand the
+        serialization + durable write to the writer.  Returns the path the
+        checkpoint WILL commit to; the epoch checkpoint also clears the
+        interrupt file and runs retention GC after it commits."""
+        return self._submit(self._epoch_job(epoch, state))
+
+    def save_interrupt(self, state) -> str:
+        """Preemption snapshot: fetched here, written in the background,
+        then FLUSHED — the caller is about to exit, so the write must be
+        durable before this returns."""
+        path = self._submit(self._interrupt_job(state))
+        self.flush()
+        return path
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until every queued snapshot is durably committed (the
+        ``timeout`` is unused — the bounded slot already caps the wait at
+        two serialization+writes); raises if any background write failed."""
+        del timeout
+        self._q.join()
+        self._raise_pending()
+
+    def close(self) -> None:
+        """Flush and stop the writer thread (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._thread.join()
+        self._raise_pending()
+
+
+class SyncSnapshotter(_SnapshotterBase):
+    """Same interface, written inline on the calling thread
+    (``ft.async_snapshots=false`` — the pre-ft behavior, now with
+    manifests and GC so integrity semantics do not depend on the mode)."""
+
+    def save_epoch(self, epoch: int, state) -> str:
+        return _write_job(self._epoch_job(epoch, state), self.prefix)
+
+    def save_interrupt(self, state) -> str:
+        return _write_job(self._interrupt_job(state), self.prefix)
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def make_snapshotter(prefix: str, cfg, steps_per_epoch: Optional[int] = None):
+    """The ``core/fit.py`` factory: async unless ``ft.async_snapshots`` is
+    off."""
+    if cfg is not None and cfg.ft.async_snapshots:
+        return AsyncSnapshotter(prefix, cfg, steps_per_epoch)
+    return SyncSnapshotter(prefix, cfg, steps_per_epoch)

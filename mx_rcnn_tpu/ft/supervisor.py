@@ -1,0 +1,343 @@
+"""Crash-loop supervisor: kill training M times, auto-resume, prove the
+survivor bit-identical to an uninterrupted run.
+
+This is the machine-checked version of the claim in ``core/fit.py`` —
+"the step-folded RNG + deterministic per-epoch shuffle make the continued
+run bit-identical to an uninterrupted one" — which until this subsystem
+was pinned only by in-process pytest (no real process ever died).  The
+supervisor runs ``tools/train.py`` as a SUBPROCESS, injects kills
+(SIGTERM through the production preemption path, SIGKILL with no chance
+to react) and disk faults (truncate / flip-byte / stale-interrupt) via
+``--fault_plan``, restarts with ``--resume auto`` until the run
+completes, then compares the survivor's final checkpoint against a
+control run byte for byte.
+
+Progress is guaranteed, not assumed: SIGTERM advances the resume point to
+the kill step (interrupt checkpoint), while SIGKILL loses exactly the
+steps since the last committed snapshot — so SIGKILL triggers are placed
+just past an epoch boundary (the supervisor schedules against the next
+boundary; a SIGKILL storm inside one epoch would otherwise loop forever,
+which is a real deployment lesson, not a harness artifact).
+
+``measure_snapshot_overhead`` times the same jitted step with and without
+per-epoch snapshots (async and sync) for the <5%-overhead acceptance
+number.  ``python -m mx_rcnn_tpu.tools.crashloop`` drives everything and
+emits the BENCH-style record (``docs/ft_crashloop.json``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+# one kill event the scheduler will realize as a concrete fault plan once
+# it knows the resume point: (file_fault or None, signal name, placement)
+# placement 'mid' = resume point + small delta (step-exact TERM resume);
+# 'boundary' = next epoch boundary + small delta (a committed epoch
+# checkpoint exists to fall back to — required for SIGKILL progress and
+# for file faults, which need a checkpoint on disk to corrupt)
+KillEvent = Tuple[Optional[str], str, str]
+
+DEFAULT_EVENTS: Tuple[KillEvent, ...] = (
+    (None, "TERM", "mid"),          # planned preemption, mid-epoch
+    (None, "KILL", "boundary"),     # planned hard kill
+    (None, "TERM", "mid"),          # random-step preemption
+    ("truncate-last-ckpt", "KILL", "boundary"),  # torn write + hard kill
+    ("flip-byte", "KILL", "boundary"),           # bit rot + hard kill
+    ("stale-interrupt", "KILL", "boundary"),     # crash between commit+clear
+)
+
+SMOKE_EVENTS: Tuple[KillEvent, ...] = (
+    (None, "TERM", "mid"),
+    ("truncate-last-ckpt", "KILL", "boundary"),
+)
+
+
+def _child_env() -> Dict[str, str]:
+    """CPU platform + the shared persistent XLA compile cache, so restart
+    attempts pay disk reads instead of recompiles (same routing as
+    tests/conftest.py gives its subprocess children)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    cache = env.get("MXRCNN_TEST_JAX_CACHE", "/tmp/mxrcnn_jax_test_cache")
+    env["JAX_COMPILATION_CACHE_DIR"] = cache
+    env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0.5"
+    env["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "0"
+    return env
+
+
+def _train_cmd(prefix: str, *, network: str, dataset: str, end_epoch: int,
+               seed: int, num_images: int, image_size: Tuple[int, int],
+               resume: bool, fault_plan: Optional[str]) -> List[str]:
+    h, w = image_size
+    cmd = [sys.executable, "-m", "mx_rcnn_tpu.tools.train",
+           "--network", network, "--dataset", dataset,
+           "--prefix", prefix, "--end_epoch", str(end_epoch),
+           "--seed", str(seed), "--frequent", "1000", "--no_flip",
+           "--dataset_kw",
+           repr({"num_images": num_images, "image_size": (h, w),
+                 "max_objects": 3}),
+           # the miniature recipe of tests/conftest.py — shrink_tiny_cfg —
+           # expressed as CLI overrides so the child is a REAL production
+           # entry point, not a test harness
+           "--set", "train__rpn_pre_nms_top_n=1024",
+           "--set", "train__rpn_post_nms_top_n=300",
+           "--set", "train__max_gt_boxes=8",
+           "--set", f"bucket__scale={min(h, w)}",
+           "--set", f"bucket__max_size={max(h, w)}",
+           "--set", f"bucket__shapes=(({h},{w}),({w},{h}))"]
+    if resume:
+        cmd += ["--resume", "auto"]
+    if fault_plan:
+        cmd += ["--fault_plan", fault_plan]
+    return cmd
+
+
+def _progress(prefix: str):
+    """(step, ref) of the newest VALID checkpoint under prefix (0, None if
+    nothing restorable) — the supervisor's only view of child progress,
+    deliberately the same scanner the child resumes through."""
+    from mx_rcnn_tpu.ft.integrity import latest_valid_checkpoint
+
+    ref = latest_valid_checkpoint(prefix)
+    return (0, None) if ref is None else (ref.step, ref)
+
+
+def run_crashloop(workdir: str, *, events: Tuple[KillEvent, ...] = None,
+                  network: str = "tiny", dataset: str = "synthetic",
+                  end_epoch: int = 5, num_images: int = 32,
+                  image_size: Tuple[int, int] = (128, 160), seed: int = 0,
+                  rng_seed: int = 0, attempt_timeout_s: float = 900.0,
+                  max_attempts: int = 30) -> Dict:
+    """Control run + kill/resume gauntlet + bit-exact comparison.
+
+    Returns the record dict (see ``tools/crashloop.py`` for the CLI and
+    the JSON contract).  Raises on a child that dies for a reason other
+    than an injected kill, on no-progress loops, and on timeout.
+    """
+    from mx_rcnn_tpu.utils.checkpoint import checkpoint_path, load_checkpoint
+
+    events = DEFAULT_EVENTS if events is None else tuple(events)
+    steps_per_epoch = num_images  # batch 1, --no_flip
+    total_steps = end_epoch * steps_per_epoch
+    rng = np.random.RandomState(rng_seed)
+    os.makedirs(workdir, exist_ok=True)
+    kw = dict(network=network, dataset=dataset, end_epoch=end_epoch,
+              seed=seed, num_images=num_images, image_size=image_size)
+    env = _child_env()
+
+    def run_child(prefix, resume, fault_plan, label):
+        cmd = _train_cmd(prefix, resume=resume, fault_plan=fault_plan, **kw)
+        t0 = time.perf_counter()
+        proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                              timeout=attempt_timeout_s)
+        wall = time.perf_counter() - t0
+        fallbacks = proc.stderr.count("checkpoint integrity: SKIPPING")
+        logger.info("[%s] exit=%s wall=%.1fs fallbacks=%d", label,
+                    proc.returncode, wall, fallbacks)
+        return proc, wall, fallbacks
+
+    # ---- control: uninterrupted run, same seed/recipe --------------------
+    control_prefix = os.path.join(workdir, "control", "e2e")
+    proc, control_wall, _ = run_child(control_prefix, False, None, "control")
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"control run failed (exit {proc.returncode}):\n{proc.stderr[-4000:]}")
+    cstep, _ = _progress(control_prefix)
+    if cstep < total_steps:
+        raise RuntimeError(f"control run finished at step {cstep} < "
+                           f"{total_steps} — recipe/schedule mismatch")
+
+    # ---- survivor: the kill/resume gauntlet ------------------------------
+    prefix = os.path.join(workdir, "survivor", "e2e")
+    attempts: List[Dict] = []
+    kills_survived = 0
+    fallback_events = 0
+    pending = list(events)
+    for attempt in range(max_attempts):
+        cur, _ref = _progress(prefix)
+        if cur >= total_steps:
+            break
+        plan = None
+        event = None
+        if pending:
+            file_fault, sig, placement = pending[0]
+            if placement == "boundary":
+                # +1 epoch: a committed checkpoint exists to resume from.
+                # Corrupting faults go +2: they destroy the NEWEST committed
+                # checkpoint, so an OLDER one must exist for the scanner's
+                # fallback to be a real fallback and not a fresh start.
+                # (stale-interrupt corrupts nothing — +1 is enough.)
+                ahead = 2 if file_fault in ("truncate-last-ckpt",
+                                            "flip-byte") else 1
+                boundary = (cur // steps_per_epoch + ahead) * steps_per_epoch
+                kill_step = boundary + int(rng.randint(2, 6))
+            else:
+                boundary = None
+                kill_step = cur + int(rng.randint(3, 12))
+            if kill_step <= total_steps - 2:
+                event = pending.pop(0)
+                parts = []
+                if file_fault:
+                    # @after pins the fault to the snapshot committed at
+                    # this boundary (the async writer lands a beat later)
+                    parts.append(f"{file_fault}@step={kill_step - 1}"
+                                 f"@after={boundary}")
+                parts.append(f"kill@step={kill_step}@sig={sig}")
+                plan = ",".join(parts)
+            else:
+                # too close to the end to kill meaningfully: drop the
+                # remaining events LOUDLY (the caller checks kills_survived)
+                logger.warning("dropping %d unplaced kill event(s) — run "
+                               "too close to completion", len(pending))
+                pending.clear()
+        proc, wall, fallbacks = run_child(
+            prefix, resume=attempt > 0 or cur > 0, fault_plan=plan,
+            label=f"attempt {attempt} plan={plan}")
+        fallback_events += fallbacks
+        after, _ = _progress(prefix)
+        rec = {"attempt": attempt, "plan": plan, "exit": proc.returncode,
+               "resume_step": cur, "progress_step": after,
+               "wall_s": round(wall, 1), "fallbacks": fallbacks}
+        attempts.append(rec)
+        killed = proc.returncode < 0 or (
+            plan is not None and "sig=TERM" in plan and proc.returncode == 0
+            and after < total_steps)
+        if killed:
+            kills_survived += 1
+        elif proc.returncode != 0:
+            raise RuntimeError(
+                f"survivor attempt {attempt} died WITHOUT an injected kill "
+                f"(exit {proc.returncode}):\n{proc.stderr[-4000:]}")
+    else:
+        raise RuntimeError(f"crashloop did not converge in {max_attempts} "
+                           f"attempts; attempts={attempts}")
+
+    # ---- verdict: bit-identical final TrainState -------------------------
+    pa = checkpoint_path(control_prefix, end_epoch)
+    pb = checkpoint_path(prefix, end_epoch)
+    import hashlib
+
+    sha = [hashlib.sha256(open(p, "rb").read()).hexdigest() for p in (pa, pb)]
+    ra, rb = load_checkpoint(control_prefix, end_epoch), \
+        load_checkpoint(prefix, end_epoch)
+    import jax
+
+    la, ta = jax.tree_util.tree_flatten(ra)
+    lb, tb = jax.tree_util.tree_flatten(rb)
+    bit_identical = (ta == tb and len(la) == len(lb) and all(
+        np.asarray(x).dtype == np.asarray(y).dtype
+        and np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb)))
+
+    return {
+        "total_steps": total_steps,
+        "steps_per_epoch": steps_per_epoch,
+        "end_epoch": end_epoch,
+        "kills_survived": kills_survived,
+        "kills_planned": len(events),
+        "fallback_events": fallback_events,
+        "attempts": attempts,
+        "control_wall_s": round(control_wall, 1),
+        "final_ckpt_sha256": {"control": sha[0], "survivor": sha[1]},
+        "files_identical": sha[0] == sha[1],
+        "bit_identical": bool(bit_identical),
+    }
+
+
+def measure_snapshot_overhead(steps: int = 96, snapshot_every: int = 32,
+                              warmup: int = 5) -> Dict:
+    """Snapshot cost at the crashloop's per-epoch cadence, two views:
+
+    * ``*_overhead_pct`` — end-to-end mean-step-time inflation vs no
+      checkpointing.  On THIS 1-core box the async writer contends with
+      training for the only core, so async ≈ sync here — an upper bound,
+      not the design point (a TPU host runs the writer on one of 180+
+      idle cores).
+    * ``*_stall_ms_per_snapshot`` / ``async_stall_overhead_pct`` — time
+      the TRAINING THREAD is blocked per snapshot (async: device_get +
+      owned copy + enqueue; sync: the full serialize+write+fsync).  This
+      is what the step pipeline pays on a host with spare cores, i.e. the
+      number the <5% acceptance criterion is checked against — and the
+      async/sync stall ratio is the measured value of moving
+      serialization off the training thread.
+
+    Uses the tiny network on a 128x160 canvas (CPU-sized); the stall gap
+    GROWS with model size (the stall is a memcpy vs a full serialize).
+    """
+    import tempfile
+
+    import jax
+
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.core.train import make_train_step, setup_training
+    from mx_rcnn_tpu.ft.snapshot import AsyncSnapshotter, SyncSnapshotter
+    from mx_rcnn_tpu.models import build_model
+    from mx_rcnn_tpu.tools.profile_step import make_batch
+
+    cfg = generate_config("tiny", "PascalVOC")
+    cfg = cfg.replace_in("train", rpn_pre_nms_top_n=256,
+                         rpn_post_nms_top_n=64, batch_rois=32,
+                         max_gt_boxes=8, rpn_min_size=2)
+    model = build_model(cfg)
+    key = jax.random.PRNGKey(0)
+    state, tx = setup_training(model, cfg, key, (1, 128, 160, 3),
+                               steps_per_epoch=1000)
+    batch = make_batch(cfg, 1, 128, 160)
+    step = jax.jit(make_train_step(model, cfg, tx), donate_argnums=(0,))
+
+    def run(n, snap=None, s0=None):
+        s = jax.tree_util.tree_map(np.asarray, s0)  # fresh, undonated copy
+        s = jax.device_put(s)
+        for _ in range(warmup):
+            s, m = step(s, batch, key)
+        jax.block_until_ready(m)
+        stalls = []
+        t0 = time.perf_counter()
+        for i in range(n):
+            s, m = step(s, batch, key)
+            if snap is not None and (i + 1) % snapshot_every == 0:
+                t1 = time.perf_counter()
+                snap.save_epoch((i + 1) // snapshot_every, s)
+                stalls.append(time.perf_counter() - t1)
+        jax.block_until_ready(m)
+        if snap is not None:
+            snap.flush()
+        wall = time.perf_counter() - t0
+        return wall / n, (float(np.mean(stalls)) if stalls else 0.0)
+
+    base, _ = run(steps, None, state)
+    with tempfile.TemporaryDirectory() as d:
+        a = AsyncSnapshotter(os.path.join(d, "async", "m"), cfg,
+                             steps_per_epoch=snapshot_every)
+        t_async, stall_a = run(steps, a, state)
+        a.close()
+        t_sync, stall_s = run(
+            steps, SyncSnapshotter(os.path.join(d, "sync", "m"), cfg,
+                                   snapshot_every), state)
+    epoch_s = snapshot_every * base
+    return {
+        "steps": steps,
+        "snapshot_every": snapshot_every,
+        "base_step_ms": round(base * 1e3, 2),
+        "async_step_ms": round(t_async * 1e3, 2),
+        "sync_step_ms": round(t_sync * 1e3, 2),
+        # end-to-end on this box (1-core writer-contention upper bound)
+        "async_overhead_pct_1core": round((t_async - base) / base * 100, 2),
+        "sync_overhead_pct_1core": round((t_sync - base) / base * 100, 2),
+        # train-thread stall: the pipeline cost on a host with spare cores
+        "async_stall_ms_per_snapshot": round(stall_a * 1e3, 2),
+        "sync_stall_ms_per_snapshot": round(stall_s * 1e3, 2),
+        "async_stall_overhead_pct": round(stall_a / epoch_s * 100, 2),
+        "sync_stall_overhead_pct": round(stall_s / epoch_s * 100, 2),
+    }

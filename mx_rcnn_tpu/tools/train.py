@@ -29,6 +29,53 @@ from mx_rcnn_tpu.utils.checkpoint import restore_state
 logger = logging.getLogger("mx_rcnn_tpu")
 
 
+def _legacy_resume(state, prefix: str, steps_per_epoch: int):
+    """Unverified auto-resume (plain ``--resume``, and the ``--resume
+    auto`` fallback for pre-manifest run dirs): a SIGTERM interrupt
+    checkpoint (step-exact) wins over epoch checkpoints; missing/corrupt
+    files fail loudly at restore — never a silent from-scratch run when
+    checkpoints exist.  Returns (state, begin_epoch)."""
+    import os
+
+    from mx_rcnn_tpu.utils.checkpoint import (interrupt_path,
+                                              latest_checkpoint,
+                                              restore_interrupt,
+                                              restore_state)
+
+    if os.path.exists(interrupt_path(prefix)):
+        state, saved_spe = restore_interrupt(state, prefix)
+        _check_spe(saved_spe, steps_per_epoch, prefix)
+        step = int(state.step)
+        begin_epoch = step // steps_per_epoch
+        logger.info("resumed mid-epoch from %s (step %d → epoch %d)",
+                    interrupt_path(prefix), step, begin_epoch)
+        return state, begin_epoch
+    found = latest_checkpoint(prefix)
+    if found:
+        begin_epoch = found[0]
+        state = restore_state(state, prefix, begin_epoch)
+        logger.info("resumed from %s epoch %d", prefix, begin_epoch)
+        return state, begin_epoch
+    logger.info("--resume: nothing under %s, starting fresh", prefix)
+    return state, 0
+
+
+def _check_spe(saved_spe, steps_per_epoch: int, prefix: str) -> None:
+    """Interrupt checkpoints are step-exact only under the same
+    batches-per-epoch; mismatch must fail loudly (shared by the legacy and
+    verified resume paths so the validation cannot diverge)."""
+    from mx_rcnn_tpu.utils.checkpoint import interrupt_path
+
+    if saved_spe is not None and saved_spe != steps_per_epoch:
+        raise ValueError(
+            f"interrupt checkpoint was written with "
+            f"{saved_spe} steps/epoch but this run has "
+            f"{steps_per_epoch} (different batch size, device "
+            f"count, or dataset) — step-exact resume is impossible; "
+            f"delete {interrupt_path(prefix)} to resume from the "
+            f"last epoch checkpoint instead")
+
+
 def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
               end_epoch: int = None, lr: float = None, lr_step: str = None,
               num_devices: int = 1, frequent: int = None, seed: int = 0,
@@ -36,8 +83,8 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
               roidb=None, dataset_kw: dict = None,
               frozen_prefixes=None, mode: str = "e2e", proposals=None,
               init_from=None, profile_dir: str = None, dcn_size: int = 1,
-              resume: bool = False, stop_flag=None,
-              device_cache: bool = False):
+              resume=False, stop_flag=None,
+              device_cache: bool = False, fault_plan: str = None):
     """Train; returns the final TrainState.
 
     ``mode``: 'e2e' | 'rpn' | 'rcnn' — the alternate-training stage drivers
@@ -50,8 +97,14 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
     loaded from ``cfg.dataset``.
     ``resume``: restore the newest state under ``prefix`` — a SIGTERM
     interrupt checkpoint (mid-epoch, step-exact) if present, else the
-    highest epoch checkpoint.  ``stop_flag``: polled per step; True ⇒ save
-    an interrupt checkpoint and return (see ``core.fit.fit``).
+    highest epoch checkpoint.  ``resume="auto"`` additionally VERIFIES
+    candidates (manifest + SHA-256, ``ft/integrity.py``) and falls back
+    past corrupt/truncated/manifest-less files instead of crashing on the
+    first bad one — the crash-loop supervisor's resume mode.
+    ``stop_flag``: polled per step; True ⇒ save an interrupt checkpoint
+    and return (see ``core.fit.fit``).
+    ``fault_plan``: a ``ft/faults.py`` plan spec this process executes
+    against itself (crash-loop certification; never set in production).
     """
     if end_epoch is None:
         end_epoch = cfg.default.e2e_epoch
@@ -100,40 +153,59 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
         p, s = load_param(*init_from)
         state = state._replace(params=p, batch_stats=s)
         logger.info("initialized params from %s epoch %d", *init_from)
-    if resume and begin_epoch == 0:
-        # auto-resume: a SIGTERM interrupt checkpoint (step-exact) wins over
-        # epoch checkpoints; an explicit --begin_epoch bypasses this and
-        # falls through to the loud restore_state below (missing file ⇒
-        # FileNotFoundError, never a silent from-scratch run)
+    if resume == "auto" and begin_epoch == 0:
+        # integrity-verified resume (ft/integrity.py): scan candidates
+        # newest→oldest by manifest step, verify checksums, fall back past
+        # corrupt/truncated/manifest-less files with a loud log — the
+        # crash-loop supervisor's resume mode (docs/FT.md)
         import os
 
-        from mx_rcnn_tpu.utils.checkpoint import (interrupt_path,
+        from mx_rcnn_tpu.ft.integrity import latest_valid_checkpoint
+        from mx_rcnn_tpu.utils.checkpoint import (config_fingerprint,
+                                                  interrupt_path,
                                                   latest_checkpoint,
                                                   restore_interrupt)
 
-        if os.path.exists(interrupt_path(prefix)):
-            state, saved_spe = restore_interrupt(state, prefix)
-            if saved_spe is not None and saved_spe != steps_per_epoch:
-                raise ValueError(
-                    f"interrupt checkpoint was written with "
-                    f"{saved_spe} steps/epoch but this run has "
-                    f"{steps_per_epoch} (different batch size, device "
-                    f"count, or dataset) — step-exact resume is impossible; "
-                    f"delete {interrupt_path(prefix)} to resume from the "
-                    f"last epoch checkpoint instead")
-            step = int(state.step)
-            begin_epoch = step // steps_per_epoch
-            logger.info("resumed mid-epoch from %s (step %d → epoch %d)",
-                        interrupt_path(prefix), step, begin_epoch)
+        ref = latest_valid_checkpoint(prefix)
+        if ref is None and (os.path.exists(interrupt_path(prefix))
+                            or latest_checkpoint(prefix)):
+            # checkpoints exist but none VERIFIES — e.g. a pre-manifest
+            # run directory.  Starting from scratch here would silently
+            # overwrite them; fall back to the legacy UNVERIFIED resume
+            # (a genuinely corrupt file then fails loudly at restore).
+            logger.warning(
+                "--resume auto: checkpoints exist under %s but none has a "
+                "verifying manifest (pre-manifest run?) — falling back to "
+                "UNVERIFIED legacy resume instead of starting over", prefix)
+            state, begin_epoch = _legacy_resume(state, prefix,
+                                                steps_per_epoch)
+        elif ref is None:
+            logger.info("--resume auto: nothing restorable under %s, "
+                        "starting fresh", prefix)
         else:
-            found = latest_checkpoint(prefix)
-            if found:
-                begin_epoch = found[0]
-                state = restore_state(state, prefix, begin_epoch)
-                logger.info("resumed from %s epoch %d", prefix, begin_epoch)
+            fp_now = config_fingerprint(cfg)
+            fp_ckpt = ref.manifest.get("config_fingerprint")
+            if fp_ckpt and fp_ckpt != fp_now:
+                logger.warning(
+                    "resume: checkpoint %s was written under config "
+                    "fingerprint %s but this run is %s — the recipe "
+                    "changed; the continued run is NOT the same experiment",
+                    ref.path, fp_ckpt, fp_now)
+            if ref.kind == "interrupt":
+                state, saved_spe = restore_interrupt(state, prefix)
+                _check_spe(saved_spe, steps_per_epoch, prefix)
+                step = int(state.step)
+                begin_epoch = step // steps_per_epoch
+                logger.info("resumed mid-epoch from verified %s "
+                            "(step %d → epoch %d)", ref.path, step,
+                            begin_epoch)
             else:
-                logger.info("--resume: nothing under %s, starting fresh",
-                            prefix)
+                begin_epoch = ref.epoch
+                state = restore_state(state, prefix, begin_epoch)
+                logger.info("resumed from verified %s (epoch %d, step %d)",
+                            ref.path, ref.epoch, ref.step)
+    elif resume and begin_epoch == 0:
+        state, begin_epoch = _legacy_resume(state, prefix, steps_per_epoch)
     elif begin_epoch > 0:
         state = restore_state(state, prefix, begin_epoch)
         logger.info("resumed from %s epoch %d", prefix, begin_epoch)
@@ -148,12 +220,19 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
             f"dcn_size={dcn_size} requires num_devices > 1 (got "
             f"{num_devices}) — the (dcn, ici) mesh only exists in "
             "multi-device training")
+    step_callback = None
+    if fault_plan:
+        from mx_rcnn_tpu.ft.faults import FaultInjector, parse_plan
+
+        injector = FaultInjector(parse_plan(fault_plan), prefix)
+        step_callback = injector.on_step
+        logger.warning("fault injection ACTIVE: %s", fault_plan)
     try:
         state = fit(model, cfg, state, tx, loader, end_epoch, key,
                     begin_epoch=begin_epoch, prefix=prefix,
                     frequent=frequent, mesh=mesh, mode=mode,
                     profile_dir=profile_dir, stop_flag=stop_flag,
-                    device_cache=device_cache)
+                    device_cache=device_cache, step_callback=step_callback)
     finally:
         if decode_pool is not None:
             decode_pool.close()
@@ -240,10 +319,22 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "hierarchical gradient all-reduce (multi-host DP)")
     p.add_argument("--no_flip", action="store_true")
     p.add_argument("--no_shuffle", action="store_true")
-    p.add_argument("--resume", action="store_true",
+    p.add_argument("--resume", nargs="?", const=True, default=False,
+                   choices=[True, "auto"], metavar="auto",
                    help="resume from the newest state under --prefix: a "
                         "SIGTERM interrupt checkpoint (step-exact) if "
-                        "present, else the highest epoch checkpoint")
+                        "present, else the highest epoch checkpoint.  "
+                        "'--resume auto' additionally verifies manifests + "
+                        "SHA-256 and falls back past corrupt/truncated "
+                        "files (docs/FT.md)")
+    p.add_argument("--fault_plan", default=None,
+                   help="fault-injection plan this process executes against "
+                        "itself, e.g. 'kill@step=7@sig=KILL' — crash-loop "
+                        "certification only (mx_rcnn_tpu/ft/faults.py)")
+    p.add_argument("--dataset_kw", default=None,
+                   help="Python-literal dict of extra dataset-constructor "
+                        "kwargs, e.g. \"{'num_images': 32}\" (synthetic "
+                        "sizing for smokes and the crash-loop driver)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--profile_dir", default=None,
                    help="capture a jax.profiler trace of early steps here")
@@ -261,6 +352,11 @@ def main(argv=None):
                         format="%(asctime)s %(name)s %(message)s")
     args = parse_args(argv)
     cfg = config_from_args(args)
+    dataset_kw = None
+    if args.dataset_kw:
+        import ast
+
+        dataset_kw = ast.literal_eval(args.dataset_kw)
 
     # graceful preemption: first SIGTERM finishes the in-flight step, saves
     # a step-exact interrupt checkpoint and exits; --resume picks it up
@@ -284,7 +380,8 @@ def main(argv=None):
               pretrained_epoch=args.pretrained_epoch,
               profile_dir=args.profile_dir, dcn_size=args.dcn_size,
               resume=args.resume, stop_flag=lambda: stop["flag"],
-              device_cache=args.device_cache)
+              device_cache=args.device_cache, fault_plan=args.fault_plan,
+              dataset_kw=dataset_kw)
 
 
 if __name__ == "__main__":

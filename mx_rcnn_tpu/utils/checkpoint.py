@@ -22,6 +22,8 @@ Design differences from the reference:
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 from typing import Any, Dict, Iterable, Optional, Tuple
 
@@ -36,17 +38,90 @@ def checkpoint_path(prefix: str, epoch: int) -> str:
 
 
 def _atomic_write(path: str, data: bytes) -> str:
-    """Atomic rename write: a crash mid-write can't corrupt an existing
-    file.  Single implementation shared by the epoch and interrupt
-    checkpoints so the write discipline cannot diverge."""
+    """Durable atomic rename write: tmp → fsync(tmp) → replace →
+    fsync(dir).  A crash mid-write can't corrupt an existing file, and a
+    HOST crash after the replace can't lose the rename (the directory
+    entry itself is synced).  Single implementation shared by the epoch
+    and interrupt checkpoints and their manifests so the write discipline
+    cannot diverge (tests/test_checkpoint.py pins the call order)."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    dir_fd = os.open(d or ".", os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
     return path
+
+
+# ---- manifests --------------------------------------------------------------
+# A checkpoint is COMMITTED only once its manifest exists: the data file is
+# written (and fsynced) first, the manifest last, so a kill anywhere in
+# between leaves either a complete older checkpoint or a committed new one —
+# never an undetectably torn file.  The integrity scanner
+# (mx_rcnn_tpu/ft/integrity.py) treats manifest-less or checksum-mismatched
+# files as uncommitted and falls back past them.
+
+
+def manifest_path(path: str) -> str:
+    """Sidecar manifest for a checkpoint data file."""
+    return path + ".manifest.json"
+
+
+_FINGERPRINT_SECTIONS = ("train", "network", "dataset", "default", "bucket")
+
+
+def config_fingerprint(cfg) -> str:
+    """Stable fingerprint of the TRAINING-SEMANTICS sections of a frozen
+    Config (their reprs are deterministic).  Recorded in every manifest so
+    a resume under a different recipe is detected loudly instead of
+    silently training a different model.  Operational sections (ft, serve,
+    test) are deliberately excluded: changing a retention or serving knob
+    does not change the training trajectory, and flagging it would
+    desensitize the warning that exists to catch real recipe drift."""
+    parts = "\n".join(repr(getattr(cfg, s)) for s in _FINGERPRINT_SECTIONS
+                      if hasattr(cfg, s))
+    return hashlib.sha256(parts.encode()).hexdigest()[:16]
+
+
+def write_manifest(path: str, data: bytes, *, kind: str, step: int,
+                   epoch: Optional[int] = None,
+                   steps_per_epoch: Optional[int] = None,
+                   config_fp: Optional[str] = None) -> str:
+    """Write the commit-point manifest for ``path`` whose payload bytes are
+    ``data`` (hashed here, not re-read, so the manifest can never describe
+    bytes other than the ones just written)."""
+    manifest = {
+        "format": 1,
+        "kind": kind,
+        "step": int(step),
+        "epoch": epoch,
+        "steps_per_epoch": steps_per_epoch,
+        "config_fingerprint": config_fp,
+        "files": {os.path.basename(path): {
+            "sha256": hashlib.sha256(data).hexdigest(),
+            "bytes": len(data),
+        }},
+    }
+    return _atomic_write(manifest_path(path),
+                         json.dumps(manifest, indent=1).encode())
+
+
+def read_manifest(path: str) -> Optional[Dict[str, Any]]:
+    """Parsed manifest for checkpoint ``path``, or None if absent or
+    unparseable (an unparseable manifest means an uncommitted snapshot)."""
+    try:
+        with open(manifest_path(path), "rb") as f:
+            return json.loads(f.read().decode())
+    except (FileNotFoundError, ValueError, UnicodeDecodeError):
+        return None
 
 
 def _atomic_save(path: str, state) -> str:
@@ -60,12 +135,46 @@ def _restore_file(path: str, template_state):
     return serialization.from_state_dict(template_state, raw)
 
 
-def save_checkpoint(prefix: str, epoch: int, state) -> str:
+def serialize_state(host_state) -> bytes:
+    """msgpack bytes of an already-fetched (host-side) TrainState.  The
+    device_get/serialize split is what lets the async snapshotter
+    (ft/snapshot.py) take only the cheap fetch on the training thread."""
+    return serialization.msgpack_serialize(
+        serialization.to_state_dict(host_state))
+
+
+def serialize_interrupt(host_state, steps_per_epoch: Optional[int]) -> bytes:
+    """msgpack bytes of the interrupt payload (state + steps_per_epoch)."""
+    return serialization.msgpack_serialize({
+        "state": serialization.to_state_dict(host_state),
+        "steps_per_epoch": steps_per_epoch,
+    })
+
+
+def commit_checkpoint(path: str, data: bytes, *, kind: str, step: int,
+                      epoch: Optional[int] = None,
+                      steps_per_epoch: Optional[int] = None,
+                      config_fp: Optional[str] = None) -> str:
+    """Durably write ``data`` then its manifest (the commit point)."""
+    _atomic_write(path, data)
+    write_manifest(path, data, kind=kind, step=step, epoch=epoch,
+                   steps_per_epoch=steps_per_epoch, config_fp=config_fp)
+    return path
+
+
+def save_checkpoint(prefix: str, epoch: int, state, *,
+                    steps_per_epoch: Optional[int] = None,
+                    config_fp: Optional[str] = None) -> str:
     """Serialize a full TrainState (params, batch_stats, opt_state, step).
 
     Ref ``do_checkpoint`` epoch_end_callback; returns the written path.
+    Writes the data file then its commit-point manifest.
     """
-    return _atomic_save(checkpoint_path(prefix, epoch), state)
+    host = jax.device_get(state)
+    return commit_checkpoint(
+        checkpoint_path(prefix, epoch), serialize_state(host),
+        kind="epoch", step=int(np.asarray(host.step)), epoch=epoch,
+        steps_per_epoch=steps_per_epoch, config_fp=config_fp)
 
 
 def load_checkpoint(prefix: str, epoch: int) -> Dict[str, Any]:
@@ -98,7 +207,8 @@ def interrupt_path(prefix: str) -> str:
     return f"{prefix}-interrupt.ckpt"
 
 
-def save_interrupt(prefix: str, state, steps_per_epoch: int = None) -> str:
+def save_interrupt(prefix: str, state, steps_per_epoch: int = None, *,
+                   config_fp: Optional[str] = None) -> str:
     """Atomically save a mid-epoch TrainState for preemption resume.
 
     ``steps_per_epoch`` is recorded alongside the state: mid-epoch resume
@@ -106,12 +216,11 @@ def save_interrupt(prefix: str, state, steps_per_epoch: int = None) -> str:
     valid if the resuming run has the SAME batches-per-epoch (batch size,
     device count, dataset); the restore validates it loudly.
     """
-    payload = {
-        "state": serialization.to_state_dict(jax.device_get(state)),
-        "steps_per_epoch": steps_per_epoch,
-    }
-    return _atomic_write(interrupt_path(prefix),
-                         serialization.msgpack_serialize(payload))
+    host = jax.device_get(state)
+    return commit_checkpoint(
+        interrupt_path(prefix), serialize_interrupt(host, steps_per_epoch),
+        kind="interrupt", step=int(np.asarray(host.step)),
+        steps_per_epoch=steps_per_epoch, config_fp=config_fp)
 
 
 def restore_interrupt(template_state, prefix: str):
@@ -130,29 +239,38 @@ def restore_interrupt(template_state, prefix: str):
 
 def clear_interrupt(prefix: str) -> None:
     """Drop a stale interrupt checkpoint (called once training has
-    progressed past it — an epoch checkpoint now supersedes it)."""
-    try:
-        os.unlink(interrupt_path(prefix))
-    except FileNotFoundError:
-        pass
+    progressed past it — an epoch checkpoint now supersedes it).  The
+    manifest goes FIRST: dropping the commit point before the data means a
+    kill between the two unlinks leaves an uncommitted file the integrity
+    scanner skips, never a committed-looking orphan."""
+    for p in (manifest_path(interrupt_path(prefix)), interrupt_path(prefix)):
+        try:
+            os.unlink(p)
+        except FileNotFoundError:
+            pass
+
+
+def list_checkpoints(prefix: str, max_epoch: int = 1000
+                     ) -> Tuple[Tuple[int, str], ...]:
+    """All epoch checkpoints under ``prefix`` as (epoch, path), ascending."""
+    found = []
+    d = os.path.dirname(prefix) or "."
+    base = os.path.basename(prefix)
+    if not os.path.isdir(d):
+        return ()
+    for name in os.listdir(d):
+        if name.startswith(base + "-") and name.endswith(".ckpt"):
+            stem = name[len(base) + 1:-5]
+            if stem.isdigit() and int(stem) <= max_epoch:
+                found.append((int(stem), os.path.join(d, name)))
+    return tuple(sorted(found))
 
 
 def latest_checkpoint(prefix: str, max_epoch: int = 1000
                       ) -> Optional[Tuple[int, str]]:
     """Highest-epoch checkpoint under ``prefix``, or None."""
-    best = None
-    d = os.path.dirname(prefix) or "."
-    base = os.path.basename(prefix)
-    if not os.path.isdir(d):
-        return None
-    for name in os.listdir(d):
-        if name.startswith(base + "-") and name.endswith(".ckpt"):
-            stem = name[len(base) + 1:-5]
-            if stem.isdigit():
-                e = int(stem)
-                if e <= max_epoch and (best is None or e > best[0]):
-                    best = (e, os.path.join(d, name))
-    return best
+    found = list_checkpoints(prefix, max_epoch)
+    return found[-1] if found else None
 
 
 def _matches(name: str, prefixes: Iterable[str]) -> bool:

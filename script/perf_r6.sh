@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Round-6 queued perf captures (fire the moment the chip answers):
+#
+#   1. batch-8 stage table, N=16 unrolled chains (VERDICT r5 weak #2):
+#      per-stage attribution for the 92.7 imgs/s batch-8 headline, so the
+#      next perf lever is a measurement, not a guess.
+#   2. refresh the r2-era "Other configs" rows (VERDICT r5 weak #3):
+#      VGG16 VOC07 (BASELINE config 1) and ResNet-50 under the CURRENT
+#      recipe (pre-NMS 6000, bf16 momentum, anchor-subsample fix).
+#
+# Both are single commands over existing tools; results go into
+# docs/PERF.md ("Round-6" section).  Run on a host that sees the v5e
+# chip (this repo's dev box lost it mid-round — see PERF.md).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== waiting for a non-CPU jax device =="
+python - <<'EOF'
+import jax
+d = jax.devices()[0]
+print("device:", d.platform, d.device_kind)
+assert d.platform != "cpu", "no accelerator visible — do not record CPU numbers"
+EOF
+
+echo "== 1. batch-8 stage table (N=16, adopted 6000 recipe) =="
+python -m mx_rcnn_tpu.tools.profile_step --network resnet101 --dataset coco \
+    --batch_images 8 --iters 16 --prenms 6000
+
+echo "== 2a. VGG16 VOC07 row refresh (current recipe) =="
+python -m mx_rcnn_tpu.tools.profile_step --network vgg --dataset PascalVOC \
+    --batch_images 2 --iters 16 --prenms 6000
+
+echo "== 2b. ResNet-50 row refresh (current recipe) =="
+python -m mx_rcnn_tpu.tools.profile_step --network resnet50 --dataset coco \
+    --batch_images 2 --iters 16 --prenms 6000

@@ -99,6 +99,48 @@ def test_checkpoint_file_is_atomic(tmp_path):
     assert not os.path.exists(checkpoint_path(prefix, 1) + ".tmp")
 
 
+def test_atomic_write_discipline_tmp_fsync_replace_dirsync(tmp_path,
+                                                           monkeypatch):
+    """The durability contract of ``_atomic_write`` (docs/FT.md): the tmp
+    file is fsynced BEFORE the rename, and the parent directory AFTER —
+    otherwise a host crash can lose either the bytes or the rename and the
+    'atomic' checkpoint silently vanishes.  Records the actual syscall
+    order via monkeypatching."""
+    from mx_rcnn_tpu.utils import checkpoint as ckpt
+
+    events = []
+    real_fsync, real_replace, real_open = os.fsync, os.replace, os.open
+
+    fd_kind = {}
+
+    def spy_open(path, flags, *a, **kw):
+        fd = real_open(path, flags, *a, **kw)
+        fd_kind[fd] = "dir" if os.path.isdir(path) else "file"
+        return fd
+
+    def spy_fsync(fd):
+        events.append(("fsync", fd_kind.get(fd, "file")))
+        return real_fsync(fd)
+
+    def spy_replace(src, dst):
+        events.append(("replace", os.path.basename(dst)))
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "open", spy_open)
+    monkeypatch.setattr(os, "fsync", spy_fsync)
+    monkeypatch.setattr(os, "replace", spy_replace)
+    path = str(tmp_path / "sub" / "x.ckpt")
+    ckpt._atomic_write(path, b"payload")
+
+    # regular file open() (the tmp write) doesn't route through os.open,
+    # so 'file' fsync events are the data fsync; exactly one of each step
+    # in the required order: fsync(tmp) -> replace -> fsync(dir)
+    assert events == [("fsync", "file"), ("replace", "x.ckpt"),
+                      ("fsync", "dir")]
+    with open(path, "rb") as f:
+        assert f.read() == b"payload"
+
+
 @pytest.mark.slow
 def test_orbax_export_import_roundtrip(tmp_path):
     """Native checkpoint → orbax directory → TrainState, bit-exact

@@ -1,0 +1,424 @@
+"""Fault-tolerance subsystem tests (mx_rcnn_tpu/ft/, docs/FT.md).
+
+Covers the four layers without subprocesses so the whole file runs under
+the CPU tier-1 environment: async snapshot equivalence (background-written
+checkpoint bit-equal to a synchronous one), manifest commit-point +
+corrupt/truncated/manifest-less fallback ordering, retention GC keep-set,
+fault-plan determinism, writer-failure surfacing, the cached-path
+determinism pin (the double-donation aliasing fix in core/fit.py), and an
+in-process kill/resume → bit-exact-params case.  The real-process version
+of the last one — actual SIGKILLs, torn files, subprocess restarts — is
+``make ft-smoke`` / ``tools/crashloop.py``.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from tests.test_train_step import KEY, make_batch, tiny_setup
+
+from mx_rcnn_tpu.core.fit import fit
+from mx_rcnn_tpu.ft.faults import Fault, FaultInjector, parse_plan
+from mx_rcnn_tpu.ft.integrity import (gc_checkpoints, latest_valid_checkpoint,
+                                      retention_keep_set, scan_candidates,
+                                      verify_checkpoint)
+from mx_rcnn_tpu.ft.snapshot import (AsyncSnapshotter, SnapshotError,
+                                     SyncSnapshotter)
+from mx_rcnn_tpu.utils.checkpoint import (checkpoint_path, interrupt_path,
+                                          list_checkpoints, manifest_path,
+                                          read_manifest, restore_interrupt,
+                                          restore_state, save_checkpoint,
+                                          save_interrupt)
+
+
+class FakeLoader:
+    """Deterministic in-memory loader: len + iteration, single bucket."""
+
+    shuffle = False
+
+    def __init__(self, batches):
+        self.batches = list(batches)
+
+    def __len__(self):
+        return len(self.batches)
+
+    def __iter__(self):
+        return iter(self.batches)
+
+
+def _read(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+# ---- snapshot.py ----------------------------------------------------------
+
+
+def test_async_snapshot_bit_equal_to_sync(tmp_path):
+    cfg, model, tx, state = tiny_setup()
+    pa = str(tmp_path / "a" / "m")
+    pb = str(tmp_path / "b" / "m")
+    a = AsyncSnapshotter(pa, cfg, steps_per_epoch=7)
+    a.save_epoch(1, state)
+    a.save_interrupt(state)
+    a.close()
+    b = SyncSnapshotter(pb, cfg, steps_per_epoch=7)
+    b.save_epoch(1, state)
+    b.save_interrupt(state)
+    assert _read(checkpoint_path(pa, 1)) == _read(checkpoint_path(pb, 1))
+    assert _read(interrupt_path(pa)) == _read(interrupt_path(pb))
+    # manifests identical up to the file-name key
+    ma, mb = read_manifest(checkpoint_path(pa, 1)), \
+        read_manifest(checkpoint_path(pb, 1))
+    assert list(ma["files"].values()) == list(mb["files"].values())
+    assert (ma["step"], ma["epoch"], ma["steps_per_epoch"]) == \
+        (mb["step"], mb["epoch"], mb["steps_per_epoch"])
+    assert ma["config_fingerprint"] == mb["config_fingerprint"]
+
+
+def test_snapshotter_writes_survive_donation(tmp_path):
+    """The snapshot must OWN its bytes: overwrite the live state's buffers
+    right after save_epoch returns (what the next donating train step
+    does) and the committed file must still hold the old values."""
+    cfg, model, tx, state = tiny_setup()
+    prefix = str(tmp_path / "m")
+    snap = AsyncSnapshotter(prefix, cfg, steps_per_epoch=7)
+    leaves = jax.tree.leaves(state.params)
+    before = np.asarray(leaves[0]).copy()
+    snap.save_epoch(1, state)
+    # clobber the host views of every param buffer (CPU backend: numpy
+    # views alias device memory — see ft/snapshot.py fetch_owned)
+    for leaf in leaves:
+        arr = np.asarray(jax.device_get(leaf))
+        if arr.flags.writeable:
+            arr.fill(123.0)
+    snap.close()
+    restored = restore_state(tiny_setup()[3], prefix, 1)
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(restored.params)[0]), before)
+
+
+def test_writer_failure_surfaces_on_training_thread(tmp_path, monkeypatch):
+    import mx_rcnn_tpu.ft.snapshot as snapmod
+
+    cfg, model, tx, state = tiny_setup()
+
+    def boom(job, prefix):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(snapmod, "_write_job", boom)
+    snap = AsyncSnapshotter(str(tmp_path / "m"), cfg, steps_per_epoch=7)
+    snap.save_epoch(1, state)  # enqueues; failure lands in the writer
+    with pytest.raises(SnapshotError):
+        snap.flush()
+    snap.close()
+
+
+def test_slot_blocks_then_fails_loudly(tmp_path, monkeypatch):
+    """Bounded in-flight window (one writing + one queued): while a write
+    is stuck, the SECOND pending snapshot fills the queue slot and the
+    request for a third must block and then raise after slot_timeout_s —
+    never an unbounded backlog of host copies."""
+    import threading
+
+    import mx_rcnn_tpu.ft.snapshot as snapmod
+
+    cfg, model, tx, state = tiny_setup()
+    release = threading.Event()
+    orig = snapmod._write_job
+
+    def slow(job, prefix):
+        release.wait(10.0)
+        return orig(job, prefix)
+
+    monkeypatch.setattr(snapmod, "_write_job", slow)
+    snap = AsyncSnapshotter(str(tmp_path / "m"), cfg, steps_per_epoch=7,
+                            slot_timeout_s=0.2)
+    snap.save_epoch(1, state)   # writer picks this up and blocks
+    snap.save_epoch(2, state)   # fills the depth-1 slot
+    with pytest.raises(SnapshotError):
+        snap.save_epoch(3, state)
+    release.set()
+    snap.close()
+
+
+# ---- integrity.py ---------------------------------------------------------
+
+
+def _save_epochs(prefix, state, epochs, spe=7):
+    for e in epochs:
+        save_checkpoint(prefix, e, state, steps_per_epoch=spe)
+
+
+def test_verify_checkpoint_catches_each_corruption(tmp_path):
+    _, _, _, state = tiny_setup()
+    prefix = str(tmp_path / "m")
+    path = save_checkpoint(prefix, 1, state)
+    assert verify_checkpoint(path) == (True, "ok")
+
+    # truncation: size mismatch
+    data = _read(path)
+    with open(path, "wb") as f:
+        f.write(data[: len(data) // 2])
+    ok, reason = verify_checkpoint(path)
+    assert not ok and "truncated" in reason
+
+    # flip: same size, sha mismatch
+    bad = bytearray(data)
+    bad[len(bad) // 2] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(bad))
+    ok, reason = verify_checkpoint(path)
+    assert not ok and "sha256" in reason
+
+    # manifest-less: uncommitted
+    with open(path, "wb") as f:
+        f.write(data)
+    os.unlink(manifest_path(path))
+    ok, reason = verify_checkpoint(path)
+    assert not ok and "manifest" in reason
+
+
+def test_fallback_ordering_newest_to_oldest(tmp_path, caplog):
+    """Corrupt the two newest checkpoints: the scanner must walk past both
+    (loudly) and return the newest clean one."""
+    import logging
+
+    _, _, _, state = tiny_setup()
+    prefix = str(tmp_path / "m")
+    _save_epochs(prefix, state, (1, 2, 3))
+    # epoch 3: truncated; epoch 2: byte flipped
+    p3, p2 = checkpoint_path(prefix, 3), checkpoint_path(prefix, 2)
+    with open(p3, "r+b") as f:
+        f.truncate(100)
+    with open(p2, "r+b") as f:
+        f.seek(50)
+        b = f.read(1)
+        f.seek(50)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with caplog.at_level(logging.WARNING, logger="mx_rcnn_tpu"):
+        ref = latest_valid_checkpoint(prefix)
+    assert ref is not None and ref.kind == "epoch" and ref.epoch == 1
+    assert sum("SKIPPING" in r.message for r in caplog.records) == 2
+
+
+def test_scan_prefers_interrupt_by_step_not_name(tmp_path):
+    """A FRESH interrupt (higher step) outranks epoch checkpoints; a STALE
+    one (step <= newest epoch) loses to the epoch file."""
+    _, _, _, state = tiny_setup()
+    prefix = str(tmp_path / "m")
+    state5 = state._replace(step=np.int32(5))
+    state7 = state._replace(step=np.int32(7))
+    save_checkpoint(prefix, 1, state5, steps_per_epoch=5)
+    save_interrupt(prefix, state7, 5)
+    ref = latest_valid_checkpoint(prefix)
+    assert ref.kind == "interrupt" and ref.step == 7
+
+    # stale: interrupt at the same step as the epoch file → epoch wins
+    save_interrupt(prefix, state5, 5)
+    ref = latest_valid_checkpoint(prefix)
+    assert ref.kind == "epoch" and ref.epoch == 1
+    # candidates stay ordered best-first
+    kinds = [c.kind for c in scan_candidates(prefix)]
+    assert kinds == ["epoch", "interrupt"]
+
+
+def test_retention_keep_set():
+    assert retention_keep_set(range(1, 13), keep_last=3, keep_every=5) == \
+        {5, 10, 11, 12}
+    assert retention_keep_set([1, 2, 3], keep_last=0, keep_every=2) == {2}
+    assert retention_keep_set([1, 2, 3], keep_last=2, keep_every=0) == {2, 3}
+    # keep_every=1 (the config DEFAULT): every epoch is a keeper
+    assert retention_keep_set([1, 2, 3], keep_last=1, keep_every=1) == \
+        {1, 2, 3}
+
+
+def test_gc_checkpoints_deletes_outside_keep_set(tmp_path):
+    _, _, _, state = tiny_setup()
+    prefix = str(tmp_path / "m")
+    _save_epochs(prefix, state, range(1, 13))
+    deleted = gc_checkpoints(prefix, keep_last=3, keep_every=5)
+    assert len(deleted) == 8
+    kept = [e for e, _ in list_checkpoints(prefix)]
+    assert kept == [5, 10, 11, 12]
+    for _, path in list_checkpoints(prefix):
+        assert os.path.exists(manifest_path(path))
+    # manifests of deleted checkpoints are gone too
+    assert not os.path.exists(manifest_path(checkpoint_path(prefix, 1)))
+
+
+# ---- faults.py ------------------------------------------------------------
+
+
+def test_parse_plan_deterministic_and_loud():
+    plan = parse_plan("kill@step=9@sig=TERM, flip-byte@step=3@offset=64,"
+                      "truncate-last-ckpt@step=5")
+    assert plan == (
+        Fault("flip-byte", 3, "KILL", 64),
+        Fault("truncate-last-ckpt", 5, "KILL", None),
+        Fault("kill", 9, "TERM", None),
+    )
+    assert parse_plan("kill@step=9@sig=TERM") == plan[2:]
+    for bad in ("explode@step=1", "kill", "kill@step=1@sig=HUP",
+                "kill@step=2@what=3", "kill@step"):
+        with pytest.raises(ValueError):
+            parse_plan(bad)
+
+
+def test_fault_injector_fires_each_fault_once(tmp_path):
+    killed = []
+    inj = FaultInjector(parse_plan("kill@step=4@sig=TERM,kill@step=6"),
+                        str(tmp_path / "m"), kill_fn=killed.append)
+    for step in range(1, 10):
+        inj.on_step(step)
+    import signal as sigmod
+
+    assert killed == [sigmod.SIGTERM, sigmod.SIGKILL]
+
+
+def test_truncate_and_stale_interrupt_faults(tmp_path):
+    _, _, _, state = tiny_setup()
+    prefix = str(tmp_path / "m")
+    save_checkpoint(prefix, 1, state, steps_per_epoch=7)
+    inj = FaultInjector(parse_plan("truncate-last-ckpt@step=2"), prefix,
+                        kill_fn=lambda s: None)
+    inj.on_step(2)
+    ok, reason = verify_checkpoint(checkpoint_path(prefix, 1))
+    assert not ok and "truncated" in reason
+
+    # stale-interrupt plants a VALID manifest recording the old step
+    save_checkpoint(prefix, 2, state, steps_per_epoch=7)
+    inj = FaultInjector(parse_plan("stale-interrupt@step=3"), prefix,
+                        kill_fn=lambda s: None)
+    inj.on_step(3)
+    assert verify_checkpoint(interrupt_path(prefix))[0]
+    ref = latest_valid_checkpoint(prefix)
+    assert ref.kind == "epoch" and ref.epoch == 2  # stale one out-ranked
+
+
+# ---- fit integration: kill/resume bit-exact, cached-path determinism ------
+
+
+def _fit_tiny(prefix, state, epochs, loader_batches, cfg, model, tx,
+              stop_after=None, device_cache=False):
+    loader = FakeLoader(loader_batches)
+    counter = {"n": 0}
+
+    def stop():
+        counter["n"] += 1
+        return stop_after is not None and counter["n"] > stop_after
+
+    return fit(model, cfg, state, tx, loader, epochs, KEY, prefix=prefix,
+               frequent=1000, stop_flag=stop if stop_after else None,
+               device_cache=device_cache)
+
+
+def _assert_states_bit_equal(a, b):
+    assert int(a.step) == int(b.step)
+    for x, y in zip(jax.tree.leaves(a.params), jax.tree.leaves(b.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    for x, y in zip(jax.tree.leaves(a.opt_state),
+                    jax.tree.leaves(b.opt_state)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_in_process_kill_resume_bit_exact(tmp_path):
+    """Interrupt mid-epoch via stop_flag (the SIGTERM path), resume through
+    the INTEGRITY SCANNER, finish — final TrainState bit-identical to an
+    uninterrupted run.  The subprocess/SIGKILL version is `make ft-smoke`."""
+    cfg, model, tx, state0 = tiny_setup()
+    batches = [make_batch(seed=s) for s in range(3)]  # 3 steps/epoch
+
+    ref = _fit_tiny(None, state0, 2, batches, cfg, model, tx)
+
+    prefix = str(tmp_path / "m" / "e2e")
+    _, _, _, s1 = tiny_setup()
+    # stop fires after step 2 of 3 — mid-epoch, so an interrupt checkpoint
+    # (not an epoch one) must be the resume point
+    _fit_tiny(prefix, s1, 2, batches, cfg, model, tx, stop_after=1)
+    ref_ckpt = latest_valid_checkpoint(prefix)
+    assert ref_ckpt.kind == "interrupt" and ref_ckpt.step == 2
+
+    # resume exactly like tools/train.py --resume auto
+    _, _, _, template = tiny_setup()
+    resumed, spe = restore_interrupt(template, prefix)
+    assert spe == 3
+    final = _fit_tiny(prefix, resumed, 2, batches, cfg, model, tx)
+    _assert_states_bit_equal(ref, final)
+    # the epoch checkpoint superseded the interrupt (cleared post-commit)
+    assert not os.path.exists(interrupt_path(prefix))
+
+
+def test_resume_falls_back_past_corrupt_epoch_bit_exact(tmp_path):
+    """Corrupt the NEWEST epoch checkpoint after a finished run: resume via
+    the scanner lands on the previous epoch, re-trains the lost epoch, and
+    reproduces the pristine final checkpoint BIT-EXACTLY (deterministic
+    replay is what makes torn-write recovery lossless here)."""
+    cfg, model, tx, state0 = tiny_setup()
+    batches = [make_batch(seed=s) for s in range(3)]
+    prefix = str(tmp_path / "m" / "e2e")
+    final = _fit_tiny(prefix, state0, 2, batches, cfg, model, tx)
+
+    p2 = checkpoint_path(prefix, 2)
+    pristine = _read(p2)
+    with open(p2, "r+b") as f:
+        f.truncate(64)
+    ref = latest_valid_checkpoint(prefix)
+    assert ref.kind == "epoch" and ref.epoch == 1
+
+    _, _, _, template = tiny_setup()
+    resumed = restore_state(template, prefix, ref.epoch)
+    refit = _fit_tiny(prefix, resumed, 2, batches, cfg, model, tx)
+    _assert_states_bit_equal(final, refit)
+    assert _read(p2) == pristine  # the re-written checkpoint byte-matches
+
+
+@pytest.mark.slow
+def test_resume_auto_falls_back_to_legacy_for_premanifest_dirs(tmp_path,
+                                                               caplog):
+    """A run dir from before the manifest era has valid checkpoints but
+    nothing that VERIFIES; --resume auto must fall back to the unverified
+    legacy resume with a loud warning — never silently start over and
+    overwrite them (code-review finding)."""
+    import glob
+    import logging
+
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.tools.train import train_net
+    from tests.conftest import shrink_tiny_cfg
+
+    cfg = shrink_tiny_cfg(generate_config(
+        "tiny", "synthetic", dataset__root_path=str(tmp_path),
+        dataset__dataset_path=str(tmp_path / "synthetic"),
+        dataset__num_classes=4))
+    kw = dict(lr=0.001, seed=0, frequent=1000,
+              dataset_kw=dict(num_images=16, image_size=(128, 160),
+                              max_objects=3))
+    prefix = str(tmp_path / "m" / "e2e")
+    train_net(cfg, prefix=prefix, end_epoch=1, **kw)
+    for m in glob.glob(prefix + "*manifest.json"):
+        os.unlink(m)  # simulate a pre-manifest run directory
+    with caplog.at_level(logging.WARNING, logger="mx_rcnn_tpu"):
+        final = train_net(cfg, prefix=prefix, end_epoch=2, resume="auto",
+                          **kw)
+    assert any("UNVERIFIED legacy resume" in r.message
+               for r in caplog.records)
+    assert int(final.step) == 32  # resumed from epoch 1, trained epoch 2
+    assert os.path.exists(checkpoint_path(prefix, 2))
+
+
+def test_cached_fit_is_deterministic(tmp_path):
+    """Regression pin for the double-donation aliasing bug: the cached
+    step's gather index was built as a zero-copy view of state.step, and
+    donating both (argnums 0 and 2) made training NONDETERMINISTIC on the
+    CPU backend.  Two identical cached fits must now be bit-identical."""
+    batches = [make_batch(seed=s) for s in range(3)]
+
+    def run():
+        cfg, model, tx, state = tiny_setup()
+        return _fit_tiny(None, state, 2, batches, cfg, model, tx,
+                         device_cache=True)
+
+    _assert_states_bit_equal(run(), run())
