@@ -13,7 +13,8 @@ NATIVE_DIR := mx_rcnn_tpu/native
 NATIVE_LIB := $(NATIVE_DIR)/libmxrcnn_native.so
 NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 
-.PHONY: all native lint test test-all test-gate serve-smoke ft-smoke clean
+.PHONY: all native lint test test-all test-gate serve-smoke ft-smoke \
+	obs-smoke clean
 
 all: native
 
@@ -50,6 +51,15 @@ test-all:
 serve-smoke:
 	python -m mx_rcnn_tpu.tools.loadgen --smoke --check
 
+# observability smoke (docs/OBSERVABILITY.md): 2-epoch tiny train with
+# obs fully enabled + serve burst into the same registry — fails unless
+# ONE /metrics scrape shows step, loader, snapshot AND request metrics,
+# events.jsonl keeps its {ts, event} schema, the profiler window rolled
+# up non-empty, and the steady-state epoch lowered ZERO new programs.
+# ~1 min warm (shares the XLA compile cache with the test suite).
+obs-smoke:
+	python -m mx_rcnn_tpu.tools.obs_smoke --check
+
 # fault-tolerance smoke (docs/FT.md): a 2-kill crash loop on the tiny
 # model with synthetic data — one SIGTERM through the preemption path,
 # one torn-write + SIGKILL — auto-resumed via the integrity scanner;
@@ -64,8 +74,9 @@ ft-smoke:
 # these for round-gate evidence; test-all stays green without them.
 # graphlint runs first: a hygiene violation fails the gate in seconds
 # instead of after 30 minutes of training; serve-smoke next (~30 s),
-# then the 2-kill crash loop (ft-smoke, ~2 min)
-test-gate: lint serve-smoke ft-smoke
+# then the observability smoke (~1 min) and the 2-kill crash loop
+# (ft-smoke, ~2 min)
+test-gate: lint serve-smoke obs-smoke ft-smoke
 	python -m pytest tests/ -x -q -m "gate"
 
 clean:

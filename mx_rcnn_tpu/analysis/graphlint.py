@@ -79,6 +79,8 @@ RULES: Dict[str, str] = {
     "GL102": "host materialization (.item()/.tolist()/device_get) in jit scope",
     "GL103": "float()/int()/bool() coercion of a traced value in jit scope",
     "GL104": "host print() in jit scope (use jax.debug.print)",
+    "GL105": "host clock / obs span in jit scope (measures tracing, not "
+             "compute)",
     "GL201": "dynamic-shape op (nonzero/argwhere/one-arg where) in jit scope",
     "GL202": "boolean-mask indexing in jit scope (dynamic result shape)",
     "GL203": "Python if/while on a traced value in jit scope",
@@ -108,6 +110,16 @@ _STATIC_BUILTINS = {
     "len", "int", "float", "bool", "str", "min", "max", "round", "abs",
     "sum", "tuple", "list", "range", "sorted", "isinstance", "getattr",
     "hasattr", "divmod", "repr",
+}
+
+# host clocks (GL105): inside a jitted function these run at TRACE time —
+# the measured interval is tracing/compilation, not the compute the
+# author meant to time.  Same for the obs/trace.py span() context manager
+# (host-side instrumentation belongs AROUND the jitted call, never in it).
+_HOST_CLOCKS = {
+    "time.time", "time.time_ns", "time.perf_counter",
+    "time.perf_counter_ns", "time.monotonic", "time.monotonic_ns",
+    "time.process_time", "time.process_time_ns",
 }
 
 # dynamic-output-shape ops (GL201); one-arg `where` is handled separately
@@ -897,6 +909,17 @@ class _Checker:
             self.report(node, "GL104",
                         "host print() in jit scope runs at TRACE time only "
                         "— use jax.debug.print", q)
+        if canon in _HOST_CLOCKS:
+            self.report(node, "GL105",
+                        f"host clock '{name}' in jit scope fires at TRACE "
+                        "time — it measures tracing, not compute; time "
+                        "around the jitted call instead", q)
+        if canon is not None and canon.rsplit(".", 1)[-1] == "span" \
+                and ("obs.trace" in canon or canon.endswith("obs.span")):
+            self.report(node, "GL105",
+                        "obs span in jit scope wraps TRACING, not device "
+                        "compute — put the span around the jitted call "
+                        "(device time comes from the profiler)", q)
         if canon is not None and canon.startswith("jax"):
             leaf = canon.rsplit(".", 1)[-1]
             if leaf in _DYNAMIC_SHAPE_OPS:

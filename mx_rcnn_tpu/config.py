@@ -276,6 +276,47 @@ class FTConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """TPU addition (no reference equivalent — the reference's only
+    instrument is the Speedometer stdout line): policy knobs for the
+    ``mx_rcnn_tpu/obs/`` unified observability layer
+    (docs/OBSERVABILITY.md).
+
+    Same 3-level precedence as every section (hardcoded defaults <
+    presets < ``--set obs__field=value`` CLI overrides).  Everything is
+    OFF by default; the disabled hot-path cost is pinned near zero by
+    ``tests/test_obs.py``.
+    """
+
+    # master switch: wire the process metrics registry into the fit
+    # loop, data loaders and snapshotter, and have the CLIs write a
+    # runs/<id>/ run record (events.jsonl + BENCH summary.json)
+    enabled: bool = False
+    # base directory for run records
+    run_dir: str = "runs"
+    # serve the unified registry as JSON GET /metrics on this port from
+    # tools/train.py (0 = off; tools/serve.py always exposes /metrics on
+    # its own HTTP front end)
+    metrics_port: int = 0
+    # collect host-side spans (obs/trace.py) and export a chrome trace
+    # into the run record on exit
+    trace: bool = False
+    trace_cap: int = 100_000     # span buffer bound (overflow counted)
+    # on-demand profiler window (obs/profiler.py): capture a
+    # profile_steps-step jax.profiler window starting at this GLOBAL
+    # step (0 = never), rolled up into per-scope device-time tables
+    profile_at_step: int = 0
+    profile_steps: int = 3
+    # where the window lands ("" = <run record dir>/profile)
+    profile_dir: str = ""
+    # arm SIGUSR2 as a live profiler toggle in the CLIs (kill -USR2 PID
+    # starts a window, a second signal stops + rolls it up)
+    sigusr2: bool = False
+    # smoothing factor for the train.loss_ema gauge (per log window)
+    loss_ema: float = 0.9
+
+
+@dataclass(frozen=True)
 class Config:
     train: TrainConfig = field(default_factory=TrainConfig)
     test: TestConfig = field(default_factory=TestConfig)
@@ -285,6 +326,7 @@ class Config:
     bucket: BucketConfig = field(default_factory=BucketConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
     ft: FTConfig = field(default_factory=FTConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
     @property
     def num_classes(self) -> int:

@@ -14,6 +14,7 @@ pipeline stays full between logs.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -23,6 +24,7 @@ import numpy as np
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.core.train import Batch, TrainState, make_train_step
 from mx_rcnn_tpu.models.faster_rcnn import FasterRCNN
+from mx_rcnn_tpu.obs import trace as obs_trace
 
 logger = logging.getLogger("mx_rcnn_tpu")
 
@@ -34,13 +36,20 @@ class Speedometer:
     Call once per batch; pass the averaged metrics on log batches (the fit
     loop aligns those with its metric window) and it prints samples/sec over
     the batches elapsed since the previous log line.
+
+    ``registry`` (an ``obs/metrics.py`` Registry): each log window also
+    publishes ``train.samples_per_sec`` and the windowed metric means
+    (``train.metric.<name>`` gauges) into the shared registry — the
+    stdout line itself stays byte-identical to the reference port
+    (pinned by ``tests/test_obs.py``).
     """
 
     def __init__(self, batch_size: int, frequent: int = 20,
-                 log: Callable[[str], None] = None):
+                 log: Callable[[str], None] = None, registry=None):
         self.batch_size = batch_size
         self.frequent = frequent
         self.log = log or logger.info
+        self.registry = registry
         self._tic = time.perf_counter()
         self._since = 0
 
@@ -57,11 +66,20 @@ class Speedometer:
             return
         elapsed = time.perf_counter() - self._tic
         speed = self._since * self.batch_size / max(elapsed, 1e-9)
+        if self.registry is not None:
+            self.registry.set_gauge("train.samples_per_sec", speed)
+            for k, v in metrics.items():
+                self.registry.set_gauge(f"train.metric.{k}", float(v))
         parts = ", ".join(f"{k}={v:.4f}" for k, v in metrics.items())
         self.log(f"Epoch[{epoch}] Batch [{nbatch}] "
                  f"Speed: {speed:.2f} samples/sec, {parts}")
         self._tic = time.perf_counter()
         self._since = 0
+
+
+# unique loop sentinel: the device-cache path legitimately yields None
+# batches, so exhaustion cannot be signalled with None
+_END = object()
 
 
 def _mean_metrics(window: List[Dict]) -> Dict[str, float]:
@@ -91,6 +109,7 @@ def fit(
     stop_flag: Optional[Callable[[], bool]] = None,
     device_cache: bool = False,
     step_callback: Optional[Callable[[int], None]] = None,
+    run_record=None,
 ) -> TrainState:
     """Run ``begin_epoch .. num_epochs`` epochs; checkpoint per epoch.
 
@@ -115,6 +134,14 @@ def fit(
     durable write + manifest commit + retention GC happen on a background
     writer thread (``cfg.ft.async_snapshots=false`` restores inline
     writes).  The interrupt save is flushed before the loop returns.
+    ``run_record``: an ``obs/runrec.py`` RunRecord — the loop appends
+    epoch/log/snapshot events to its ``events.jsonl`` (None = no record).
+    With ``cfg.obs.enabled`` the loop also records step time, data-wait
+    fraction, loss EMA and lowering counts into the process metrics
+    registry, and ``cfg.obs.profile_at_step`` opens an on-demand
+    profiler window (``obs/profiler.py``); all of it is absent from the
+    hot path when disabled (the default — overhead pinned by
+    ``tests/test_obs.py``).
     ``device_cache``: stage the loader's epoch in HBM once and gather each
     step's batch on device (``data/device_cache.py``) — for RAM/HBM-scale
     datasets on hosts or links too slow to stream per step.  Shuffling is
@@ -134,6 +161,28 @@ def fit(
     bit-identical to an uninterrupted one.
     """
     frequent = cfg.default.frequent if frequent is None else frequent
+    # -- observability wiring (cfg.obs.enabled; docs/OBSERVABILITY.md) --
+    # rec stays None when disabled, and every obs touch below hides
+    # behind a `rec is None` branch — the disabled hot path is a local
+    # None-check (cost pinned by tests/test_obs.py)
+    rec = None
+    prof = None
+    lowerings = None
+    loss_ema = None
+    if getattr(cfg, "obs", None) is not None and cfg.obs.enabled:
+        from mx_rcnn_tpu.obs.metrics import LoweringCounter, registry
+
+        rec = registry()
+        lowerings = LoweringCounter()
+        lowerings.__enter__()
+        if cfg.obs.profile_at_step > 0:
+            from mx_rcnn_tpu.obs.profiler import StepProfiler
+
+            pdir = cfg.obs.profile_dir or os.path.join(
+                run_record.dir if run_record is not None else "obs_trace",
+                "profile")
+            prof = StepProfiler(pdir, cfg.obs.profile_at_step,
+                                cfg.obs.profile_steps)
     cache = None
     if device_cache:
         import jax.numpy as jnp
@@ -198,7 +247,8 @@ def fit(
             return base(state, batch, key)
 
     n_dev = mesh.size if mesh is not None else 1
-    speedo = Speedometer(cfg.train.batch_images * n_dev, frequent)
+    speedo = Speedometer(cfg.train.batch_images * n_dev, frequent,
+                         registry=rec)
     steps_per_epoch = len(train_loader)
     done_steps = int(jax.device_get(state.step))
     snap = None
@@ -240,7 +290,19 @@ def fit(
                 if skip and not loader_skips:
                     for _ in range(skip):  # fallback: decode-and-discard
                         next(batch_iter, None)
-            for batch in batch_iter:
+            if run_record is not None:
+                run_record.event("epoch_start", epoch=epoch, skip=skip,
+                                 steps_per_epoch=steps_per_epoch)
+            while True:
+                if rec is None:
+                    batch = next(batch_iter, _END)
+                else:
+                    t_wait = time.perf_counter()
+                    with obs_trace.span("train.data_wait"):
+                        batch = next(batch_iter, _END)
+                    wait_s = time.perf_counter() - t_wait
+                if batch is _END:
+                    break
                 # trace steps [skip+2, skip+5) of the first epoch: the first
                 # two executed steps carry compile
                 if (profile_dir is not None and epoch == begin_epoch
@@ -248,7 +310,17 @@ def fit(
                     jax.profiler.start_trace(profile_dir)
                     tracing = True
                     logger.info("profiler trace started -> %s", profile_dir)
-                state, metrics = run_step(state, batch)
+                if rec is None:
+                    state, metrics = run_step(state, batch)
+                else:
+                    with obs_trace.span("train.dispatch"):
+                        state, metrics = run_step(state, batch)
+                    step_s = time.perf_counter() - t_wait
+                    rec.inc("train.steps")
+                    rec.observe("train.step_ms", step_s * 1e3)
+                    rec.observe("train.data_wait_ms", wait_s * 1e3)
+                    rec.set_gauge("train.data_wait_frac",
+                                  wait_s / max(step_s, 1e-9))
                 window.append(metrics)
                 nbatch += 1
                 if tracing and nbatch >= skip + 5:
@@ -256,6 +328,10 @@ def fit(
                     jax.profiler.stop_trace()
                     tracing = False
                     logger.info("profiler trace written to %s", profile_dir)
+                if prof is not None:
+                    m = metrics  # bind: the lambda must sync THIS step
+                    prof.on_step(epoch * steps_per_epoch + nbatch,
+                                 sync=lambda: jax.block_until_ready(m))
                 if step_callback is not None:
                     step_callback(epoch * steps_per_epoch + nbatch)
                 if stop_flag is not None and stop_flag():
@@ -269,7 +345,13 @@ def fit(
                         if tracing:
                             jax.profiler.stop_trace()
                         if snap is not None:
-                            path = snap.save_interrupt(state)
+                            with obs_trace.span("train.snapshot",
+                                                kind="interrupt"):
+                                path = snap.save_interrupt(state)
+                            if run_record is not None:
+                                run_record.event(
+                                    "interrupt", epoch=epoch, nbatch=nbatch,
+                                    path=path)
                             logger.info(
                                 "stop requested: saved interrupt checkpoint "
                                 'to "%s" (step %d) — rerun with --resume to '
@@ -280,10 +362,26 @@ def fit(
                                 "stop requested: no prefix, state not saved")
                         return state
                 if nbatch % frequent == 0:
-                    avg = _mean_metrics(window)
+                    with obs_trace.span("train.sync"):
+                        avg = _mean_metrics(window)
                     epoch_metrics.append(avg)
                     window = []
                     speedo(epoch, nbatch, avg)
+                    if rec is not None:
+                        loss = avg.get("loss")
+                        if loss is not None:
+                            a = cfg.obs.loss_ema
+                            loss_ema = (loss if loss_ema is None
+                                        else a * loss_ema + (1 - a) * loss)
+                            rec.set_gauge("train.loss_ema", loss_ema)
+                        rec.set_gauge("train.lowerings_total", lowerings.n)
+                    if run_record is not None:
+                        run_record.event(
+                            "log", epoch=epoch, nbatch=nbatch,
+                            samples_per_sec=(
+                                None if rec is None
+                                else rec.gauge("train.samples_per_sec")),
+                            **avg)
                 else:
                     speedo(epoch, nbatch, {})
             if tracing:  # epoch shorter than the trace window
@@ -292,18 +390,32 @@ def fit(
                 logger.info("profiler trace written to %s", profile_dir)
             if window:
                 epoch_metrics.append(_mean_metrics(window))
+            epoch_s = time.perf_counter() - t0
             if epoch_metrics:
                 keys = epoch_metrics[0].keys()
                 summary = ", ".join(
                     f"{k}={np.mean([m[k] for m in epoch_metrics]):.4f}"
                     for k in keys)
                 logger.info("Epoch[%d] Train summary: %s  (%.1fs)", epoch,
-                            summary, time.perf_counter() - t0)
+                            summary, epoch_s)
+            if rec is not None:
+                rec.inc("train.epochs")
+                rec.set_gauge("train.epoch_s", epoch_s)
+            if run_record is not None:
+                run_record.event(
+                    "epoch_end", epoch=epoch, nbatch=nbatch,
+                    epoch_s=round(epoch_s, 3),
+                    **{k: float(np.mean([m[k] for m in epoch_metrics]))
+                       for k in (epoch_metrics[0].keys()
+                                 if epoch_metrics else ())})
             if snap is not None:
                 # device_get here, serialize+write+manifest+GC in the
                 # background; the interrupt file is cleared by the writer
                 # only after this epoch checkpoint commits
-                path = snap.save_epoch(epoch + 1, state)
+                with obs_trace.span("train.snapshot", kind="epoch"):
+                    path = snap.save_epoch(epoch + 1, state)
+                if run_record is not None:
+                    run_record.event("snapshot", epoch=epoch, path=path)
                 logger.info('Epoch[%d] Snapshotting checkpoint to "%s"',
                             epoch, path)
             if epoch_end_callback is not None:
@@ -316,5 +428,7 @@ def fit(
                 return state
         return state
     finally:
+        if prof is not None:
+            prof.close()  # run shorter than the window: close it cleanly
         if snap is not None:
             snap.close()  # flush pending writes before the process moves on

@@ -19,6 +19,7 @@ TPU-native differences:
 from __future__ import annotations
 
 import logging
+import time
 
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -93,6 +94,16 @@ class _ImageSource:
         self.cache = cache
         self.decode_pool = decode_pool
         self._pixel_means = np.asarray(cfg.network.pixel_means, np.float32)
+        # observability (docs/OBSERVABILITY.md): with cfg.obs.enabled the
+        # loader records decode/assemble time and prefetch queue depth
+        # into the process registry; None (the default) keeps the hot
+        # path at a single attribute check
+        self._rec = None
+        obs = getattr(cfg, "obs", None)
+        if obs is not None and obs.enabled:
+            from mx_rcnn_tpu.obs.metrics import registry
+
+            self._rec = registry()
 
     def _write_slot(self, out: np.ndarray, img: np.ndarray) -> Tuple[int, int]:
         h, w = img.shape[:2]
@@ -129,6 +140,16 @@ class _ImageSource:
         derived parent-side from the record geometry (``plan_scale`` is
         pinned equal to the decode path's scale); without one, the decode
         runs in-thread through the optional cache."""
+        if self._rec is None:
+            return self._decode_into(images, recs, bucket)
+        t0 = time.perf_counter()
+        out = self._decode_into(images, recs, bucket)
+        self._rec.observe("loader.decode_ms",
+                          (time.perf_counter() - t0) * 1e3)
+        return out
+
+    def _decode_into(self, images: np.ndarray, recs, bucket
+                     ) -> List[Tuple[int, int, float]]:
         if self.decode_pool is None:
             return [self._image_into(images[j], rec, bucket)
                     for j, rec in enumerate(recs)]
@@ -151,7 +172,7 @@ class _ImageSource:
 
 
 def _prefetched(work: Iterable, make: Callable, num_workers: int,
-                prefetch: int) -> Iterator:
+                prefetch: int, rec=None) -> Iterator:
     """Run ``make(item)`` on a thread pool, keeping up to ``prefetch``
     results in flight; yield results in submission order.
 
@@ -161,7 +182,24 @@ def _prefetched(work: Iterable, make: Callable, num_workers: int,
     GIL-releasing cv2/numpy) overlaps with device steps.  Thread pool, not
     processes: the arrays are large and fork/pickle would cost more than
     the GIL does.  num_workers=0 degrades to the synchronous path.
+
+    ``rec`` (an ``obs/metrics.py`` Registry, None = off): records
+    per-batch assembly wall time (``loader.assemble_ms``, measured in the
+    worker thread so it is the true build cost, not the consumer's wait)
+    and the prefetch depth still in flight at each yield
+    (``loader.queue_depth`` — 0 means the consumer is decode-starved).
     """
+    if rec is not None:
+        inner = make
+
+        def make(item):
+            t0 = time.perf_counter()
+            out = inner(item)
+            rec.observe("loader.assemble_ms",
+                        (time.perf_counter() - t0) * 1e3)
+            rec.inc("loader.batches")
+            return out
+
     if num_workers <= 0:
         for item in work:
             yield make(item)
@@ -181,7 +219,10 @@ def _prefetched(work: Iterable, make: Callable, num_workers: int,
                 futures.append(ex.submit(make, item))
             if not futures:
                 break
-            yield futures.popleft().result()
+            fut = futures.popleft()
+            if rec is not None:
+                rec.set_gauge("loader.queue_depth", len(futures))
+            yield fut.result()
     finally:
         # early abandonment (consumer break / error): drop queued work and
         # return without waiting on in-flight batch builds
@@ -325,7 +366,7 @@ class AnchorLoader(_ImageSource):
             self._skip_next = 0
         yield from _prefetched(
             batches, lambda b: self._make_batch(b[1], b[0]),
-            self.num_workers, self.prefetch)
+            self.num_workers, self.prefetch, rec=self._rec)
 
 
 class ROIIter(AnchorLoader):
@@ -428,7 +469,7 @@ class TestLoader(_ImageSource):
                 batches.append((bucket, idx[s:s + self.batch_images]))
         yield from _prefetched(
             batches, lambda b: self._make_batch(b[1], b[0]),
-            self.num_workers, self.prefetch)
+            self.num_workers, self.prefetch, rec=self._rec)
 
 
 class ROITestLoader(TestLoader):

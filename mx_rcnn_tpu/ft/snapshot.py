@@ -30,6 +30,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import Optional
 
 import jax
@@ -62,11 +63,13 @@ class SnapshotError(RuntimeError):
 
 
 class _Job:
-    """One queued write: already-fetched host state + commit metadata."""
+    """One queued write: already-fetched host state + commit metadata.
+    ``rec`` (an ``obs/metrics.py`` Registry, None = off) rides along so
+    the write path can account bytes/latency wherever it runs."""
 
     def __init__(self, kind: str, path: str, host_state, epoch: Optional[int],
                  steps_per_epoch: Optional[int], config_fp: Optional[str],
-                 clear_interrupt_after: bool, gc_fn=None):
+                 clear_interrupt_after: bool, gc_fn=None, rec=None):
         self.kind = kind
         self.path = path
         self.host_state = host_state
@@ -75,12 +78,16 @@ class _Job:
         self.config_fp = config_fp
         self.clear_interrupt_after = clear_interrupt_after
         self.gc_fn = gc_fn
+        self.rec = rec
 
 
 def _write_job(job: _Job, prefix: str) -> str:
     """Serialize + commit one snapshot (runs on the writer thread for the
     async snapshotter, inline for the sync one — shared so the bytes on
-    disk cannot depend on which mode wrote them)."""
+    disk cannot depend on which mode wrote them).  ``job.rec`` records
+    serialized bytes and serialize→commit latency when obs is on."""
+    rec = job.rec
+    t0 = time.perf_counter()
     if job.kind == "interrupt":
         data = serialize_interrupt(job.host_state, job.steps_per_epoch)
         step = int(job.host_state.step)
@@ -90,6 +97,11 @@ def _write_job(job: _Job, prefix: str) -> str:
     commit_checkpoint(job.path, data, kind=job.kind, step=step,
                       epoch=job.epoch, steps_per_epoch=job.steps_per_epoch,
                       config_fp=job.config_fp)
+    if rec is not None:
+        rec.inc("snapshot.commits")
+        rec.inc("snapshot.bytes", len(data))
+        rec.observe("snapshot.commit_ms",
+                    (time.perf_counter() - t0) * 1e3)
     if job.clear_interrupt_after:
         # only AFTER the epoch checkpoint is committed — the interrupt
         # file must stay restorable until its superseder is durable
@@ -115,6 +127,23 @@ class _SnapshotterBase:
         self.cfg = cfg
         self.steps_per_epoch = steps_per_epoch
         self.config_fp = config_fingerprint(cfg) if cfg is not None else None
+        # observability (docs/OBSERVABILITY.md): with cfg.obs.enabled the
+        # snapshotter records training-thread stall, serialized bytes and
+        # commit latency into the process registry (None = off)
+        self._rec = None
+        obs = getattr(cfg, "obs", None)
+        if obs is not None and obs.enabled:
+            from mx_rcnn_tpu.obs.metrics import registry
+
+            self._rec = registry()
+
+    def _observe_stall(self, t0: float) -> None:
+        """The training-thread cost of one snapshot request: device_get +
+        owned copy + enqueue for the async path, the full serialize+write
+        for the sync one — the number docs/FT.md calls the stall."""
+        if self._rec is not None:
+            self._rec.observe("snapshot.stall_ms",
+                              (time.perf_counter() - t0) * 1e3)
 
     def _gc_fn(self):
         if self.cfg is None or not self.cfg.ft.keep_last:
@@ -129,12 +158,13 @@ class _SnapshotterBase:
         return _Job("epoch", checkpoint_path(self.prefix, epoch),
                     fetch_owned(state), epoch, self.steps_per_epoch,
                     self.config_fp, clear_interrupt_after=True,
-                    gc_fn=self._gc_fn())
+                    gc_fn=self._gc_fn(), rec=self._rec)
 
     def _interrupt_job(self, state) -> _Job:
         return _Job("interrupt", interrupt_path(self.prefix),
                     fetch_owned(state), None, self.steps_per_epoch,
-                    self.config_fp, clear_interrupt_after=False)
+                    self.config_fp, clear_interrupt_after=False,
+                    rec=self._rec)
 
 
 class AsyncSnapshotter(_SnapshotterBase):
@@ -201,14 +231,19 @@ class AsyncSnapshotter(_SnapshotterBase):
         serialization + durable write to the writer.  Returns the path the
         checkpoint WILL commit to; the epoch checkpoint also clears the
         interrupt file and runs retention GC after it commits."""
-        return self._submit(self._epoch_job(epoch, state))
+        t0 = time.perf_counter()
+        path = self._submit(self._epoch_job(epoch, state))
+        self._observe_stall(t0)
+        return path
 
     def save_interrupt(self, state) -> str:
         """Preemption snapshot: fetched here, written in the background,
         then FLUSHED — the caller is about to exit, so the write must be
         durable before this returns."""
+        t0 = time.perf_counter()
         path = self._submit(self._interrupt_job(state))
         self.flush()
+        self._observe_stall(t0)
         return path
 
     def flush(self, timeout: Optional[float] = None) -> None:
@@ -235,10 +270,16 @@ class SyncSnapshotter(_SnapshotterBase):
     manifests and GC so integrity semantics do not depend on the mode)."""
 
     def save_epoch(self, epoch: int, state) -> str:
-        return _write_job(self._epoch_job(epoch, state), self.prefix)
+        t0 = time.perf_counter()
+        path = _write_job(self._epoch_job(epoch, state), self.prefix)
+        self._observe_stall(t0)
+        return path
 
     def save_interrupt(self, state) -> str:
-        return _write_job(self._interrupt_job(state), self.prefix)
+        t0 = time.perf_counter()
+        path = _write_job(self._interrupt_job(state), self.prefix)
+        self._observe_stall(t0)
+        return path
 
     def flush(self, timeout: Optional[float] = None) -> None:
         pass

@@ -1,0 +1,34 @@
+"""Unified observability (docs/OBSERVABILITY.md) — one answer to "where
+did this step/request spend its time?" across training, data loading,
+checkpointing and serving (ISSUE 4).
+
+Before this package the repo had three disjoint fragments: the serving
+histograms (``serve/metrics.py``), the XSpace decoder reachable only via
+``tools/profile_step.py`` (``utils/xplane.py``), and the ``Speedometer``
+stdout line in ``core/fit.py`` — none of which could see each other or
+the ``ft/`` snapshot path.  Layers, bottom-up:
+
+* ``metrics.py``  — process-wide :class:`Registry` (counters, gauges,
+  log-bucket histograms) + the promoted ``Histogram`` /
+  ``LoweringCounter`` / ``ServeMetrics`` (``serve.metrics`` is now a
+  back-compat shim over this module) + the stdlib ``/metrics`` HTTP
+  exporter;
+* ``trace.py``    — cheap host-side spans (``with span("h2d")``) with a
+  trace-context id propagated through the serve request lifecycle and
+  the train loop, exported as chrome-trace JSON that merges with the
+  XLA device timeline via ``utils/xplane.py`` timestamps;
+* ``profiler.py`` — on-demand ``jax.profiler`` windows (config
+  ``obs.profile_at_step`` or SIGUSR2 on a live process), auto-rolled-up
+  by ``utils/xplane.py — summarize_device_time``;
+* ``runrec.py``   — structured run records: every ``tools/train.py`` /
+  ``tools/serve.py`` run writes ``runs/<id>/events.jsonl`` plus a final
+  BENCH-compatible ``summary.json``.
+
+Everything is DISABLED by default (``cfg.obs.enabled``); the disabled
+hot-path cost is pinned near zero by ``tests/test_obs.py``.
+"""
+
+from mx_rcnn_tpu.obs.metrics import (Histogram, LoweringCounter,  # noqa: F401
+                                     Registry, ServeMetrics, registry,
+                                     start_metrics_server)
+from mx_rcnn_tpu.obs.runrec import RunRecord  # noqa: F401

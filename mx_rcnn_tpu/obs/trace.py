@@ -1,0 +1,276 @@
+"""Cheap host-side span tracing with chrome-trace export.
+
+No reference equivalent.  The XLA device timeline (``jax.profiler`` →
+``utils/xplane.py``) explains where DEVICE time goes; this module is the
+host half: ``with span("h2d")`` wraps any host-side region (data wait,
+dispatch, snapshot stall, serve queue wait) with ~µs overhead, and a
+trace-context id ties the pieces of one logical operation together
+across threads — e.g. a serve request's enqueue (caller thread) →
+coalesce/dispatch (dispatcher thread) → respond hops all carry the same
+``trace_id``.
+
+Disabled (the default) the whole API is a no-op: ``span`` returns a
+shared null context manager after ONE module-flag read, so leaving the
+calls in hot paths costs a branch (pinned near zero by
+``tests/test_obs.py``).
+
+Export is the chrome trace event format (load in Perfetto /
+``chrome://tracing``):
+
+* spans → ``ph:"X"`` duration events (ts/dur in µs) on their real thread;
+* request lifecycles → ``ph:"b"/"e"`` async events keyed by trace id;
+* :func:`device_trace_events` decodes an ``*.xplane.pb`` (via
+  ``utils/xplane.py``) into the same format so host + device merge into
+  ONE timeline: host timestamps use ``time.time_ns()`` (unix epoch) and
+  xplane ``XLine.timestamp_ns`` is the same epoch clock, so the two
+  align without offset surgery (``merge_device_trace``).
+
+IMPORTANT: never call ``span`` (or any host clock) INSIDE jitted code —
+host clocks in traced code measure tracing, not compute.  graphlint rule
+GL105 enforces this.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+_lock = threading.Lock()
+_events: List[dict] = []
+_enabled = False
+_cap = 100_000
+_dropped = 0
+_tls = threading.local()
+_ids = itertools.count(1)
+
+
+def enable(cap: int = 100_000) -> None:
+    """Start collecting spans (bounded buffer of ``cap`` events; overflow
+    is counted, never grows memory)."""
+    global _enabled, _cap
+    with _lock:
+        _cap = int(cap)
+        _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    with _lock:
+        _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    """Drop collected events (keeps the enabled flag)."""
+    global _dropped
+    with _lock:
+        _events.clear()
+        _dropped = 0
+
+
+def dropped() -> int:
+    return _dropped
+
+
+def events() -> List[dict]:
+    with _lock:
+        return list(_events)
+
+
+def _emit(ev: dict) -> None:
+    global _dropped
+    with _lock:
+        if len(_events) >= _cap:
+            _dropped += 1
+            return
+        _events.append(ev)
+
+
+def _now_us() -> float:
+    # wall clock, not perf_counter: xplane device lines timestamp in
+    # ns-since-epoch, so host events on the same clock merge cleanly
+    return time.time_ns() / 1e3
+
+
+# ---------------------------------------------------------------------------
+# trace-context ids
+# ---------------------------------------------------------------------------
+
+def new_trace_id() -> str:
+    """Process-unique id tying the spans of one logical operation
+    together (e.g. one serve request across caller + dispatcher
+    threads)."""
+    return f"{os.getpid():x}.{next(_ids):x}"
+
+
+def set_trace_id(trace_id: Optional[str]) -> None:
+    """Bind a trace id to the CURRENT thread: spans opened on it attach
+    the id automatically until cleared (pass None to clear)."""
+    _tls.trace_id = trace_id
+
+
+def get_trace_id() -> Optional[str]:
+    return getattr(_tls, "trace_id", None)
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+class _Null:
+    """Reusable no-op context manager (the disabled fast path)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _Null()
+
+
+class _Span:
+    __slots__ = ("name", "args", "t0", "depth")
+
+    def __init__(self, name: str, args: Dict):
+        self.name = name
+        self.args = args
+
+    def __enter__(self) -> "_Span":
+        self.depth = getattr(_tls, "depth", 0)
+        _tls.depth = self.depth + 1
+        self.t0 = _now_us()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        t1 = _now_us()
+        _tls.depth = self.depth
+        args = {"depth": self.depth}
+        tid = self.args.pop("trace_id", None) or get_trace_id()
+        if tid is not None:
+            args["trace_id"] = tid
+        args.update(self.args)
+        _emit({"name": self.name, "ph": "X", "ts": self.t0,
+               "dur": t1 - self.t0, "pid": os.getpid(),
+               "tid": threading.get_ident(), "args": args})
+        return False
+
+
+def span(name: str, **args):
+    """``with span("h2d"): ...`` — record a duration event on this
+    thread.  Attaches the thread's bound trace id (or an explicit
+    ``trace_id=`` kwarg).  Near-free when tracing is disabled."""
+    if not _enabled:
+        return _NULL
+    return _Span(name, args)
+
+
+def complete(name: str, dur_ms: float, **args) -> None:
+    """Record a duration event that ENDED now and lasted ``dur_ms`` —
+    for intervals measured with other clocks (e.g. a request's
+    ``monotonic`` queue wait) whose endpoints span threads."""
+    if not _enabled:
+        return
+    t1 = _now_us()
+    tid = args.pop("trace_id", None) or get_trace_id()
+    a = dict(args)
+    if tid is not None:
+        a["trace_id"] = tid
+    _emit({"name": name, "ph": "X", "ts": t1 - dur_ms * 1e3,
+           "dur": dur_ms * 1e3, "pid": os.getpid(),
+           "tid": threading.get_ident(), "args": a})
+
+
+def instant(name: str, **args) -> None:
+    if not _enabled:
+        return
+    tid = args.pop("trace_id", None) or get_trace_id()
+    a = dict(args)
+    if tid is not None:
+        a["trace_id"] = tid
+    _emit({"name": name, "ph": "i", "s": "t", "ts": _now_us(),
+           "pid": os.getpid(), "tid": threading.get_ident(), "args": a})
+
+
+def async_begin(name: str, trace_id: str, **args) -> None:
+    """Open an async (cross-thread) interval keyed by ``trace_id`` —
+    chrome ``ph:"b"``.  Close it with :func:`async_end` from ANY
+    thread."""
+    if not _enabled:
+        return
+    _emit({"name": name, "ph": "b", "cat": "request", "id": trace_id,
+           "ts": _now_us(), "pid": os.getpid(),
+           "tid": threading.get_ident(),
+           "args": {"trace_id": trace_id, **args}})
+
+
+def async_end(name: str, trace_id: str, **args) -> None:
+    if not _enabled:
+        return
+    _emit({"name": name, "ph": "e", "cat": "request", "id": trace_id,
+           "ts": _now_us(), "pid": os.getpid(),
+           "tid": threading.get_ident(),
+           "args": {"trace_id": trace_id, **args}})
+
+
+# ---------------------------------------------------------------------------
+# export + device-trace merge
+# ---------------------------------------------------------------------------
+
+def export_chrome_trace(path: str, extra_events: List[dict] = None) -> str:
+    """Write collected events (plus ``extra_events``, e.g. the decoded
+    device timeline) as chrome trace JSON."""
+    evs = events() + list(extra_events or [])
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": evs, "displayTimeUnit": "ms",
+                   "metadata": {"dropped_host_events": _dropped}}, f)
+    return path
+
+
+def device_trace_events(source) -> List[dict]:
+    """Decode an ``*.xplane.pb`` path (or pre-parsed planes) into chrome
+    duration events, one pid per device plane, one tid per XLine.  Event
+    start = ``XLine.timestamp_ns + offset_ps`` — the same unix-epoch ns
+    clock host spans use, so the merged file lines up."""
+    from mx_rcnn_tpu.utils.xplane import device_planes, parse_xspace
+
+    planes = parse_xspace(source) if isinstance(source, str) else source
+    out: List[dict] = []
+    for plane in device_planes(planes):
+        pid = f"device:{plane.get('name', '?')}"
+        emd = plane.get("event_metadata", {})
+        for line in plane["lines"]:
+            base_us = line.get("timestamp_ns", 0) / 1e3
+            tid = line.get("display_name") or line.get("name", "")
+            for ev in line["events"]:
+                md = emd.get(ev.get("metadata_id"), {})
+                out.append({
+                    "name": md.get("display_name") or md.get("name", "?"),
+                    "ph": "X",
+                    "ts": base_us + ev.get("offset_ps", 0) / 1e6,
+                    "dur": ev.get("duration_ps", 0) / 1e6,
+                    "pid": pid, "tid": tid,
+                })
+    return out
+
+
+def merge_device_trace(path: str, trace_dir: str) -> str:
+    """Export host spans merged with the newest device trace under
+    ``trace_dir`` (a ``jax.profiler`` output directory) into one
+    chrome-trace file."""
+    from mx_rcnn_tpu.obs.profiler import newest_xplane
+
+    pb = newest_xplane(trace_dir)
+    extra = device_trace_events(pb) if pb else []
+    return export_chrome_trace(path, extra_events=extra)

@@ -42,6 +42,7 @@ from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.core.tester import (Predictor, _postprocess_batch,
                                      detections_from_keep, tiled_bbox_stats)
 from mx_rcnn_tpu.data.image import resize_to_bucket
+from mx_rcnn_tpu.obs import trace as obs_trace
 from mx_rcnn_tpu.serve.metrics import ServeMetrics
 from mx_rcnn_tpu.serve.queue import (EXPIRED, FAILED, SERVED, SHED,
                                      BoundedQueue, ServeRequest)
@@ -128,17 +129,31 @@ class ServingEngine:
         if self._closed or (len(self.queues[rough_bucket])
                             >= self.queues[rough_bucket].shed_watermark):
             req = ServeRequest(None, None, rough_bucket, deadline, now)
+            self._trace_admit(req)
             self.metrics.count("submitted")
             req._finish(SHED)
             self.metrics.count("shed")
             return req
         data, im_info, bucket = self.preprocess(img)
         req = ServeRequest(data, im_info, bucket, deadline, now)
+        self._trace_admit(req)
         self.metrics.count("submitted")
         if self._closed or not self.queues[bucket].offer(req):
             req._finish(SHED)
             self.metrics.count("shed")
         return req
+
+    @staticmethod
+    def _trace_admit(req: ServeRequest) -> None:
+        """Open the request's trace interval (obs/trace.py; no-op unless
+        tracing is on).  The id rides the request through the
+        queue→dispatch→respond hops, so one chrome-trace search shows a
+        request's whole lifecycle across threads."""
+        if obs_trace.enabled():
+            req.trace_id = obs_trace.new_trace_id()
+            obs_trace.async_begin(
+                "serve.request", req.trace_id,
+                bucket=f"{req.bucket[0]}x{req.bucket[1]}")
 
     def detect(self, img: np.ndarray, timeout_ms: float = None
                ) -> Dict[int, np.ndarray]:
@@ -216,13 +231,30 @@ class ServingEngine:
         killing the bucket's only dispatcher thread."""
         try:
             now = time.monotonic()
+            tracing = obs_trace.enabled()
             for r in reqs:
                 r.dispatch_t = now
                 self.metrics.observe("queue_wait_ms",
                                      (now - r.enqueue_t) * 1e3)
+                if tracing and r.trace_id is not None:
+                    # the coalescing hop, stamped from the dispatcher
+                    # thread with the request's id (the enqueue end lives
+                    # on the caller thread — monotonic interval re-anchored
+                    # to the wall clock by complete())
+                    obs_trace.complete("serve.queue_wait",
+                                       (now - r.enqueue_t) * 1e3,
+                                       trace_id=r.trace_id)
             images, im_info = self._compose(bucket, reqs)
             t0 = time.monotonic()
-            boxes_b, scores_b, keep_b = self._run(images, im_info)
+            if tracing:
+                with obs_trace.span(
+                        "serve.batch", bucket=f"{bucket[0]}x{bucket[1]}",
+                        rows=len(reqs),
+                        trace_ids=[r.trace_id for r in reqs
+                                   if r.trace_id is not None]):
+                    boxes_b, scores_b, keep_b = self._run(images, im_info)
+            else:
+                boxes_b, scores_b, keep_b = self._run(images, im_info)
             self.metrics.observe_batch(len(reqs),
                                        self.cfg.serve.batch_size,
                                        (time.monotonic() - t0) * 1e3)

@@ -1,184 +1,15 @@
-"""Serving metrics: counters + fixed-bucket latency histograms.
+"""Back-compat shim: the serving metrics were promoted to
+``mx_rcnn_tpu/obs/metrics.py`` (ISSUE 4 — one process-wide registry for
+train + loader + snapshot + serve).
 
-No reference equivalent.  Design constraints: recording must be cheap and
-lock-bounded (it runs on every request on the dispatcher thread), and the
-snapshot must be computable without storing per-request samples — so
-latencies land in log-spaced fixed-bound histograms (40 buckets spanning
-0.1 ms .. ~28 s at ×1.37 steps, ~±16% percentile resolution) and
-percentiles are read off the cumulative counts.  The same approach as
-production serving stacks (Prometheus-style histograms), in ~100 lines of
-stdlib+numpy.
-
-Also here: :class:`LoweringCounter` — the serving twin of the
-``tests/test_recompile_guard.py`` jit-cache-miss detector, counting
-``jax.monitoring`` lowering events so the loadgen / tests can assert that
-a warmed engine serves steady-state traffic with ZERO new compiles.
+Everything importable here before the promotion still is — same classes,
+same histogram bucket edges, same percentile readout, same snapshot
+format (pinned bit-identical by ``tests/test_obs.py`` so
+``tools/loadgen.py`` and the ``docs/serve_bench_*.json`` comparisons
+remain valid).  New code should import from ``mx_rcnn_tpu.obs.metrics``.
 """
 
 from __future__ import annotations
 
-import threading
-from typing import Dict, Optional
-
-import numpy as np
-
-
-class Histogram:
-    """Fixed log-spaced-bucket histogram with percentile readout.
-
-    ``percentile`` returns the UPPER bound of the bucket holding the
-    rank — a conservative (never-understated) latency estimate.
-    """
-
-    def __init__(self, lo: float = 0.1, hi: float = 30_000.0,
-                 buckets: int = 40):
-        # bounds[i] is the inclusive upper edge of bucket i; the last
-        # bucket is open-ended (+inf) so no sample is ever dropped
-        self.bounds = np.geomspace(lo, hi, buckets)
-        self.counts = np.zeros(buckets + 1, np.int64)
-        self.total = 0
-        self.sum = 0.0
-        self.max = 0.0
-
-    def record(self, value: float) -> None:
-        i = int(np.searchsorted(self.bounds, value))
-        self.counts[i] += 1
-        self.total += 1
-        self.sum += value
-        self.max = max(self.max, value)
-
-    def percentile(self, p: float) -> Optional[float]:
-        """p in [0, 100]; None when empty.  Bucket-upper-bound estimate;
-        the overflow bucket reports the observed max."""
-        if self.total == 0:
-            return None
-        rank = int(np.ceil(p / 100.0 * self.total))
-        rank = min(max(rank, 1), self.total)
-        cum = np.cumsum(self.counts)
-        i = int(np.searchsorted(cum, rank))
-        if i >= len(self.bounds):
-            return float(self.max)
-        return float(self.bounds[i])
-
-    @property
-    def mean(self) -> Optional[float]:
-        return self.sum / self.total if self.total else None
-
-
-_COUNTERS = ("submitted", "served", "shed", "expired", "failed",
-             "batches", "padded_rows")
-
-
-class ServeMetrics:
-    """Thread-safe counters + histograms for the serving engine.
-
-    Counters: every request increments ``submitted`` and exactly one of
-    ``served`` / ``shed`` / ``expired`` / ``failed`` — the zero-lost
-    accounting invariant (``submitted == sum of terminals`` once traffic
-    drains).  ``batches`` counts dispatches; ``padded_rows`` counts dead
-    rows shipped to keep the batch shape static (occupancy =
-    1 - padded/(batches*batch_size)).
-
-    Histograms (milliseconds): ``queue_wait`` (admission → dispatch),
-    ``model`` (per-batch forward+postprocess wall), ``total``
-    (admission → response) — plus ``occupancy`` (real rows per dispatched
-    batch, linear buckets via the same class is overkill, so it is
-    tracked as a counter pair instead).
-    """
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.reset()
-
-    def reset(self) -> None:
-        """Zero everything (loadgen excludes warmup from the measured
-        window this way).  Not atomic w.r.t. concurrent recorders — call
-        it only between traffic phases."""
-        self.counters: Dict[str, int] = {k: 0 for k in _COUNTERS}
-        self.hists: Dict[str, Histogram] = {
-            "queue_wait_ms": Histogram(),
-            "model_ms": Histogram(),
-            "total_ms": Histogram(),
-        }
-        self._rows = 0  # real rows dispatched (occupancy numerator) —
-        # a counter, not a per-batch list: state stays O(1) forever
-
-    def count(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self.counters[name] += n
-
-    def observe(self, name: str, value_ms: float) -> None:
-        with self._lock:
-            self.hists[name].record(value_ms)
-
-    def observe_batch(self, rows: int, batch_size: int,
-                      model_ms: float) -> None:
-        with self._lock:
-            self.counters["batches"] += 1
-            self.counters["padded_rows"] += batch_size - rows
-            self._rows += rows
-            self.hists["model_ms"].record(model_ms)
-
-    def snapshot(self) -> Dict:
-        """One consistent dict: counters, percentiles, occupancy — the
-        /metrics response body and the loadgen record source."""
-        with self._lock:
-            out: Dict = {"counters": dict(self.counters)}
-            for name, h in self.hists.items():
-                pct = {p: h.percentile(p) for p in (50, 90, 99)}
-                out[name] = {
-                    "count": h.total,
-                    "mean": None if h.mean is None else round(h.mean, 3),
-                    **{f"p{p}": None if v is None else round(v, 3)
-                       for p, v in pct.items()},
-                    "max": round(h.max, 3) if h.total else None,
-                }
-            b = self.counters["batches"]
-            out["batch_occupancy"] = {
-                "batches": b,
-                "mean_rows": round(self._rows / b, 3) if b else None,
-                "padded_rows": self.counters["padded_rows"],
-            }
-            c = self.counters
-            out["terminated"] = (c["served"] + c["shed"] + c["expired"]
-                                 + c["failed"])
-            out["in_flight"] = c["submitted"] - out["terminated"]
-            return out
-
-
-class LoweringCounter:
-    """Counts pjit lowering events (jit cache misses) inside a ``with``
-    block via ``jax.monitoring`` — fired on every trace+lower regardless
-    of the persistent XLA compile cache, so "zero new compiles on a
-    warmed engine" is assertable across cold and warm processes.
-
-    Import-light: registering the listener touches jax only on first use.
-    """
-
-    _events = {"lowerings": 0}
-    _registered = False
-
-    @classmethod
-    def _ensure_listener(cls) -> None:
-        if cls._registered:
-            return
-        import jax
-
-        def on_event(event, duration, **kw):
-            if event == "/jax/core/compile/jaxpr_to_mlir_module_duration":
-                cls._events["lowerings"] += 1
-
-        jax.monitoring.register_event_duration_secs_listener(on_event)
-        cls._registered = True
-
-    def __enter__(self) -> "LoweringCounter":
-        self._ensure_listener()
-        self._start = self._events["lowerings"]
-        return self
-
-    def __exit__(self, *exc) -> bool:
-        return False
-
-    @property
-    def n(self) -> int:
-        return self._events["lowerings"] - self._start
+from mx_rcnn_tpu.obs.metrics import (Histogram, LoweringCounter,  # noqa: F401
+                                     Registry, ServeMetrics)

@@ -27,6 +27,8 @@ from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
+from mx_rcnn_tpu.obs import trace as obs_trace
+
 
 class ShedError(RuntimeError):
     """Request rejected at admission: queue at/over its shed watermark
@@ -64,7 +66,7 @@ class ServeRequest:
 
     __slots__ = ("image", "im_info", "bucket", "enqueue_t", "deadline",
                  "state", "result", "error", "dispatch_t", "done_t",
-                 "batch_rows", "_event", "_lock")
+                 "batch_rows", "trace_id", "_event", "_lock")
 
     def __init__(self, image: np.ndarray, im_info: np.ndarray,
                  bucket: Tuple[int, int], deadline: Optional[float],
@@ -80,6 +82,7 @@ class ServeRequest:
         self.dispatch_t: Optional[float] = None
         self.done_t: Optional[float] = None
         self.batch_rows = 0         # real rows in the micro-batch served with
+        self.trace_id = None        # obs/trace.py context id (None = off)
         self._event = threading.Event()
         self._lock = threading.Lock()
 
@@ -93,6 +96,10 @@ class ServeRequest:
             self.result = result
             self.error = error
             self.done_t = time.monotonic() if now is None else now
+        if self.trace_id is not None:
+            # the respond hop: closes the async interval opened at
+            # admission, from WHICHEVER thread terminated the request
+            obs_trace.async_end("serve.request", self.trace_id, state=state)
         self._event.set()
         return True
 

@@ -35,6 +35,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from mx_rcnn_tpu.obs import trace as obs_trace
 from mx_rcnn_tpu.serve.engine import ServingEngine
 from mx_rcnn_tpu.serve.queue import (DeadlineExceeded, RequestFailed,
                                      ShedError)
@@ -111,7 +112,13 @@ class DetectionHandler(BaseHTTPRequestHandler):
             h = engine.healthz()
             self._reply(200 if h["ok"] else 503, h)
         elif self.path == "/metrics":
-            self._reply(200, engine.metrics.snapshot())
+            # the serving snapshot in its original (bench-pinned) format,
+            # plus the full registry the engine's metrics record into —
+            # when tools/serve.py wires the PROCESS registry in
+            # (cfg.obs.enabled), this one scrape is the unified view
+            snap = engine.metrics.snapshot()
+            snap["registry"] = engine.metrics.registry.snapshot()
+            self._reply(200, snap)
         else:
             self._reply(404, {"error": f"no such path {self.path!r}"})
 
@@ -149,6 +156,11 @@ class DetectionHandler(BaseHTTPRequestHandler):
         except (RequestFailed, TimeoutError) as e:
             self._reply(500, {"error": str(e)})
             return
+        if req.trace_id is not None:
+            # the HTTP hop of the request's lifecycle (same trace id as
+            # its queue/dispatch spans)
+            obs_trace.complete("serve.http", (time.monotonic() - t0) * 1e3,
+                               trace_id=req.trace_id)
         self._reply(200, {
             "detections": detections_to_json(dets,
                                              self.server.class_names),

@@ -56,11 +56,24 @@ def main(argv=None):
     args = parse_args(argv)
     cfg = generate_config(args.network, args.dataset,
                           **parse_set_overrides(args))
+    # observability (docs/OBSERVABILITY.md): publish serving metrics into
+    # the PROCESS registry (so /metrics is the unified scrape), write a
+    # runs/<id>/ record, optionally collect spans / arm SIGUSR2.  CliObs
+    # owns the wiring AND the fail-soft teardown, shared with
+    # tools/train.py
+    from mx_rcnn_tpu.obs.runrec import cli_obs
+
+    obs_sess = cli_obs(cfg, "serve")
+    metrics = None
+    if obs_sess is not None:
+        from mx_rcnn_tpu.obs.metrics import ServeMetrics, registry
+
+        metrics = ServeMetrics(registry=registry())
     model = build_model(cfg)
     params, batch_stats = load_param(args.prefix, args.epoch)
     predictor = Predictor(
         model, {"params": params, "batch_stats": batch_stats}, cfg)
-    engine = ServingEngine(predictor, cfg)
+    engine = ServingEngine(predictor, cfg, metrics=metrics)
     if not args.no_warmup:
         logger.info("warming %d bucket(s) at batch %d ...",
                     len(engine.buckets), cfg.serve.batch_size)
@@ -77,6 +90,12 @@ def main(argv=None):
     finally:
         srv.server_close()
         engine.close()
+        if obs_sess is not None:
+            snap = engine.metrics.snapshot()
+            obs_sess.record.event("serve_stats", **snap["counters"])
+            obs_sess.close(metric="serve_requests_served",
+                           value=snap["counters"]["served"],
+                           unit="requests")
 
 
 if __name__ == "__main__":

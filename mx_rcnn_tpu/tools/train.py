@@ -84,7 +84,9 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
               frozen_prefixes=None, mode: str = "e2e", proposals=None,
               init_from=None, profile_dir: str = None, dcn_size: int = 1,
               resume=False, stop_flag=None,
-              device_cache: bool = False, fault_plan: str = None):
+              device_cache: bool = False, fault_plan: str = None,
+              run_record=None, step_callback=None,
+              epoch_end_callback=None):
     """Train; returns the final TrainState.
 
     ``mode``: 'e2e' | 'rpn' | 'rcnn' — the alternate-training stage drivers
@@ -105,6 +107,12 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
     and return (see ``core.fit.fit``).
     ``fault_plan``: a ``ft/faults.py`` plan spec this process executes
     against itself (crash-loop certification; never set in production).
+    ``run_record``: an ``obs/runrec.py`` RunRecord the fit loop appends
+    structured events to (docs/OBSERVABILITY.md; None = off).
+    ``step_callback`` / ``epoch_end_callback``: forwarded to
+    ``core.fit.fit`` (instrumentation hooks — ``tools/obs_smoke.py`` uses
+    them to time steps and count per-epoch lowerings); a ``fault_plan``'s
+    injector chains in front of a caller ``step_callback``.
     """
     if end_epoch is None:
         end_epoch = cfg.default.e2e_epoch
@@ -220,19 +228,27 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
             f"dcn_size={dcn_size} requires num_devices > 1 (got "
             f"{num_devices}) — the (dcn, ici) mesh only exists in "
             "multi-device training")
-    step_callback = None
     if fault_plan:
         from mx_rcnn_tpu.ft.faults import FaultInjector, parse_plan
 
         injector = FaultInjector(parse_plan(fault_plan), prefix)
-        step_callback = injector.on_step
+        if step_callback is None:
+            step_callback = injector.on_step
+        else:
+            user_cb = step_callback
+
+            def step_callback(step, _inj=injector.on_step, _cb=user_cb):
+                _inj(step)
+                _cb(step)
         logger.warning("fault injection ACTIVE: %s", fault_plan)
     try:
         state = fit(model, cfg, state, tx, loader, end_epoch, key,
                     begin_epoch=begin_epoch, prefix=prefix,
                     frequent=frequent, mesh=mesh, mode=mode,
                     profile_dir=profile_dir, stop_flag=stop_flag,
-                    device_cache=device_cache, step_callback=step_callback)
+                    device_cache=device_cache, step_callback=step_callback,
+                    run_record=run_record,
+                    epoch_end_callback=epoch_end_callback)
     finally:
         if decode_pool is not None:
             decode_pool.close()
@@ -373,15 +389,33 @@ def main(argv=None):
     except ValueError:  # not the main thread (embedded use) — no handler
         pass
 
-    train_net(cfg, prefix=args.prefix, begin_epoch=args.begin_epoch,
-              end_epoch=args.end_epoch, lr=args.lr, lr_step=args.lr_step,
-              num_devices=args.num_devices, frequent=args.frequent,
-              seed=args.seed, pretrained=args.pretrained,
-              pretrained_epoch=args.pretrained_epoch,
-              profile_dir=args.profile_dir, dcn_size=args.dcn_size,
-              resume=args.resume, stop_flag=lambda: stop["flag"],
-              device_cache=args.device_cache, fault_plan=args.fault_plan,
-              dataset_kw=dataset_kw)
+    # observability (docs/OBSERVABILITY.md): run record + unified
+    # /metrics exporter + host-span trace + SIGUSR2 profiler toggle —
+    # all OFF unless cfg.obs asks (e.g. --set obs__enabled=true).
+    # CliObs owns the wiring AND the fail-soft teardown, shared with
+    # tools/serve.py
+    from mx_rcnn_tpu.obs.runrec import cli_obs
+
+    obs_sess = cli_obs(cfg, "train")
+    try:
+        train_net(cfg, prefix=args.prefix, begin_epoch=args.begin_epoch,
+                  end_epoch=args.end_epoch, lr=args.lr, lr_step=args.lr_step,
+                  num_devices=args.num_devices, frequent=args.frequent,
+                  seed=args.seed, pretrained=args.pretrained,
+                  pretrained_epoch=args.pretrained_epoch,
+                  profile_dir=args.profile_dir, dcn_size=args.dcn_size,
+                  resume=args.resume, stop_flag=lambda: stop["flag"],
+                  device_cache=args.device_cache, fault_plan=args.fault_plan,
+                  dataset_kw=dataset_kw,
+                  run_record=obs_sess.record if obs_sess else None)
+    finally:
+        if obs_sess is not None:
+            from mx_rcnn_tpu.obs.metrics import registry
+
+            obs_sess.close(metric="train_samples_per_sec",
+                           value=registry().gauge("train.samples_per_sec"),
+                           unit="imgs/s",
+                           steps=registry().counter("train.steps"))
 
 
 if __name__ == "__main__":

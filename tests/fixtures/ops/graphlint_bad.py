@@ -7,10 +7,12 @@ components).
 """
 
 import functools
+import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+from mx_rcnn_tpu.obs.trace import span
 
 _BAD_CONST = jnp.zeros((4,))  # GL402: module-level jnp constant
 
@@ -33,7 +35,11 @@ def jitted(x, flags=[1, 2]):  # GL303: mutable default on a static arg
         x = x + [1.0, 2.0]            # GL403: bare list literal arithmetic
     z = x.item()                      # GL102: host materialization
     u = x.astype(float)               # GL401: float64 promotion
-    return n, v, nz, w, y, z, u
+    t = time.perf_counter()           # GL105: host clock measures tracing
+    with span("step"):                # GL105: obs span in jit scope
+        x = x * 2.0
+    t2 = time.time()  # graphlint: disable=GL105 demo: a REASONED waiver silences the clock rule
+    return n, v, nz, w, y, z, u, t, t2
 
 
 def build_and_call(xs):
