@@ -14,7 +14,7 @@ NATIVE_LIB := $(NATIVE_DIR)/libmxrcnn_native.so
 NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 
 .PHONY: all native lint test test-all test-gate serve-smoke ft-smoke \
-	obs-smoke clean
+	obs-smoke perf-smoke clean
 
 all: native
 
@@ -60,6 +60,18 @@ serve-smoke:
 obs-smoke:
 	python -m mx_rcnn_tpu.tools.obs_smoke --check
 
+# perf-tooling smoke (docs/PERF.md "Round-6"): CPU-backend sanity run of
+# the stage profiler on the tiny model (N=2 unrolled chains) — fails
+# unless every stage times finite, NO timed pass retraces (jit cache
+# miss), the chain self-check holds (sum of stages ~ full step), and the
+# per-stage gauges land in the obs registry.  Guards the queued
+# script/perf_r6.sh battery: the chip capture must not be the first time
+# the tool runs.  ~1 min warm.
+perf-smoke:
+	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.profile_step \
+		--network tiny --dataset synthetic --shape 128x160 \
+		--batch_images 2 --iters 2 --check
+
 # fault-tolerance smoke (docs/FT.md): a 2-kill crash loop on the tiny
 # model with synthetic data — one SIGTERM through the preemption path,
 # one torn-write + SIGKILL — auto-resumed via the integrity scanner;
@@ -74,9 +86,9 @@ ft-smoke:
 # these for round-gate evidence; test-all stays green without them.
 # graphlint runs first: a hygiene violation fails the gate in seconds
 # instead of after 30 minutes of training; serve-smoke next (~30 s),
-# then the observability smoke (~1 min) and the 2-kill crash loop
-# (ft-smoke, ~2 min)
-test-gate: lint serve-smoke obs-smoke ft-smoke
+# then the perf-tooling smoke (~1 min), the observability smoke
+# (~1 min) and the 2-kill crash loop (ft-smoke, ~2 min)
+test-gate: lint serve-smoke perf-smoke obs-smoke ft-smoke
 	python -m pytest tests/ -x -q -m "gate"
 
 clean:

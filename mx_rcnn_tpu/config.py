@@ -74,8 +74,25 @@ class TrainConfig:
     # (measured FASTER in the full step: the fused kernel wins isolated
     # but pays ~13 ms in custom-call boundary layout copies + lost XLA
     # fusion — ops/roi_pool.py roi_align_batched docstring has the
-    # numbers); 'pallas' → the experimental VMEM-fused kernel
+    # numbers); 'blocked' → the ROI-chunked einsum pair (bit-equal
+    # forward, live (R,·,·,C) intermediate shrunk by roi_align_chunk/R,
+    # stays inside the XLA program so it pays none of the custom-call
+    # tax — the r6 lever, full-step A/B queued in script/perf_r6.sh);
+    # 'pallas' → the experimental VMEM-fused kernel
     roi_align_backend: str = "auto"
+    # ROI block size for the 'blocked' backend (ignored by the others):
+    # 64 splits the production 256-ROI batch into 4 chunks → ~70 MB live
+    # intermediate instead of ~280 MB
+    roi_align_chunk: int = 64
+    # proposal-stage NMS composition: True → the batched nms_batch path
+    # (ops/nms.py — when the jnp sweep backend is selected this is ONE
+    # cross-image tile sweep per step, decision-exact vs the per-image
+    # sweep; when the auto-guards select the Pallas kernel on TPU, the
+    # kernel still runs per image under vmap); False → vmap of per-image
+    # nms calls (the pre-r6 composition, the A/B arm for
+    # script/perf_r6.sh leg 3, which forces the jnp backend to actually
+    # engage the cross-image sweep)
+    nms_batched: bool = True
 
 
 @dataclass(frozen=True)
@@ -211,6 +228,17 @@ class BucketConfig:
     are resized the same way then padded into one of a small set of static
     buckets; aspect-ratio grouping (ref ASPECT_GROUPING) maps each image to
     the landscape or portrait bucket.
+
+    Sublane note (r6): the default 608×1024 bucket yields a 38×64 stride-16
+    feature grid, and 38 rows is hostile to the 8-sublane VPU register
+    shape (38 = 4×8 + 6 — every (H-minor) retile pads ~5%).  The
+    sublane-friendly alternative is 640×1024 (40×64 grid, 40 = 5×8) at
+    +5.3% pixels — select it per run with
+    ``--set bucket__shapes='[[640,1024],[1024,640]]'`` (anchors and bucket
+    padding regenerate from the feature shape automatically; pinned by
+    tests/test_anchors.py).  Whether the alignment win beats the pixel tax
+    is a measured chip decision: script/perf_r6.sh leg 4 runs the A/B and
+    docs/PERF.md "Round-6" records the adopt-or-refuse verdict.
     """
 
     scale: int = 600            # ref: SCALES[0][0] — target short side

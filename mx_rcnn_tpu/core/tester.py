@@ -26,7 +26,7 @@ import numpy as np
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.models.faster_rcnn import FasterRCNN
 from mx_rcnn_tpu.ops.boxes import bbox_pred, clip_boxes
-from mx_rcnn_tpu.ops.nms import nms_mask
+from mx_rcnn_tpu.ops.nms import nms_mask_batch
 
 
 class Predictor:
@@ -206,21 +206,22 @@ def _postprocess_batch(rois, roi_valid, cls_prob, deltas, im_info, scales,
     boxes_b, scores_b = _decode_batch(rois, roi_valid, cls_prob, deltas,
                                       im_info, scales, stds, means)
 
-    def one(boxes, scores, valid_i):
+    def prep(boxes, scores, valid_i):
         boxes_c = boxes.reshape(r, c, 4).transpose(1, 0, 2)  # (C, R, 4)
         scores_c = scores.T  # (C, R)
         cand = (scores_c > score_thresh) & valid_i[None, :]
-        # backend pinned to jnp: under this (classes x images) double vmap
-        # the Pallas kernel's batching rule could multiply its VMEM blocks
-        # past the scoped limit, and at eval sizes (a few hundred boxes per
-        # class) the kernel has no advantage anyway
-        keep = jax.vmap(
-            lambda b, s, v: nms_mask(b, s, nms_thresh, valid=v,
-                                     backend="jnp")
-        )(boxes_c, scores_c, cand)
-        return keep & cand
+        return boxes_c, scores_c, cand
 
-    keep_b = jax.vmap(one)(boxes_b, scores_b, roi_valid)
+    boxes_c, scores_c, cand = jax.vmap(prep)(boxes_b, scores_b, roi_valid)
+    # every (image, class) NMS in ONE cross-image batched sweep (r6) —
+    # decision-exact vs the former vmap(vmap(nms_mask)) composition.
+    # backend pinned to jnp: at eval sizes (a few hundred boxes per class)
+    # the Pallas kernel has no advantage, and the (N·C)-row batch would
+    # multiply its per-image VMEM blocks under vmap
+    keep_flat = nms_mask_batch(
+        boxes_c.reshape(n * c, r, 4), scores_c.reshape(n * c, r),
+        nms_thresh, valid=cand.reshape(n * c, r), backend="jnp")
+    keep_b = keep_flat.reshape(n, c, r) & cand
     return boxes_b, scores_b, keep_b
 
 

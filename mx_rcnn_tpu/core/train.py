@@ -37,7 +37,7 @@ from mx_rcnn_tpu.ops.losses import (
     softmax_cross_entropy_with_ignore,
     weighted_smooth_l1,
 )
-from mx_rcnn_tpu.ops.proposal import propose
+from mx_rcnn_tpu.ops.proposal import propose_batch
 from mx_rcnn_tpu.ops.roi_pool import roi_align_batched
 from mx_rcnn_tpu.ops.targets import anchor_target, proposal_target
 
@@ -159,11 +159,14 @@ def _rcnn_losses(model: FasterRCNN, variables, feat, rois, rois_valid,
 
     # 'auto' resolves to the einsum pair — the fused Pallas kernel wins
     # isolated but loses ~13 ms to custom-call boundary costs in the full
-    # step (see ops/roi_pool.py roi_align_batched); 'pallas' opts in
+    # step (see ops/roi_pool.py roi_align_batched); 'blocked' runs the
+    # same pair ROI-chunked (bit-equal forward, intermediate shrunk by
+    # roi_align_chunk/R); 'pallas' opts into the kernel
     backend = None if tr.roi_align_backend == "auto" else tr.roi_align_backend
     pooled = roi_align_batched(feat, pt.rois, model.pooled_size,
                                1.0 / model.feat_stride,
-                               backend=backend)  # (N, B, ph, pw, C)
+                               backend=backend,
+                               chunk=tr.roi_align_chunk)  # (N, B, ph, pw, C)
     flat = pooled.reshape((-1,) + pooled.shape[2:])
     cls_logits, bbox_deltas = model.apply(
         variables, flat, True, method=model.roi_head,
@@ -239,18 +242,17 @@ def loss_and_metrics(  # graphlint: jit (traced via LOSS_FNS inside the step)
     fg_scores = jax.nn.softmax(rpn_cls32, axis=-1)[..., 1]
     rpn_box_sg = jax.lax.stop_gradient(rpn_box.astype(jnp.float32))
 
-    def one_img(scores_i, box_i, info_i):
-        rois, _, roi_valid = propose(
-            scores_i, box_i, anchors, info_i,
+    with jax.named_scope("proposal"):
+        # cross-image batched NMS sweep (r6): one tile-sweep loop nest for
+        # the whole batch instead of B serialized chains under vmap —
+        # decision-exact vs vmap(propose), pinned by tests/test_proposal.py
+        rois, _, rois_valid = propose_batch(
+            fg_scores, rpn_box_sg, anchors, batch.im_info,
+            batched_nms=tr.nms_batched,
             pre_nms_top_n=tr.rpn_pre_nms_top_n,
             post_nms_top_n=tr.rpn_post_nms_top_n,
             nms_thresh=tr.rpn_nms_thresh,
             min_size=tr.rpn_min_size)
-        return rois, roi_valid
-
-    with jax.named_scope("proposal"):
-        rois, rois_valid = jax.vmap(one_img)(fg_scores, rpn_box_sg,
-                                             batch.im_info)
     with jax.named_scope("rcnn_losses"):
         rcnn_cls_loss, rcnn_bbox_loss, rcnn_metrics = _rcnn_losses(
             model, variables, feat, rois, rois_valid, batch, k_rcnn, cfg)

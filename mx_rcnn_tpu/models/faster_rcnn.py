@@ -29,7 +29,7 @@ from mx_rcnn_tpu.models.rpn import RPNHead
 from mx_rcnn_tpu.models.vgg import VGGBackbone, VGGHead
 from mx_rcnn_tpu.ops.anchors import generate_shifted_anchors
 from mx_rcnn_tpu.ops.normalize import normalize_images
-from mx_rcnn_tpu.ops.proposal import propose
+from mx_rcnn_tpu.ops.proposal import propose_batch
 from mx_rcnn_tpu.ops.roi_pool import roi_align
 
 Dtype = Any
@@ -128,17 +128,13 @@ class FasterRCNN(nn.Module):
         _, fh, fw, _ = feat.shape
         anchors = self.anchors_for(fh, fw)
         fg = jax.nn.softmax(rpn_cls.astype(jnp.float32), axis=-1)[..., 1]
-
-        def one(scores_i, box_i, info_i):
-            return propose(
-                scores_i, box_i, anchors, info_i,
-                pre_nms_top_n=pre_nms_top_n,
-                post_nms_top_n=post_nms_top_n,
-                nms_thresh=self.test_nms_thresh,
-                min_size=self.test_min_size,
-            )
-
-        return jax.vmap(one)(fg, rpn_box.astype(jnp.float32), im_info)
+        return propose_batch(
+            fg, rpn_box.astype(jnp.float32), anchors, im_info,
+            pre_nms_top_n=pre_nms_top_n,
+            post_nms_top_n=post_nms_top_n,
+            nms_thresh=self.test_nms_thresh,
+            min_size=self.test_min_size,
+        )
 
     def detect_rois(self, images: jnp.ndarray, im_info: jnp.ndarray,
                     rois: jnp.ndarray, roi_valid: jnp.ndarray
@@ -192,17 +188,13 @@ class FasterRCNN(nn.Module):
         n, fh, fw, _ = feat.shape
         anchors = self.anchors_for(fh, fw)
         fg_scores = jax.nn.softmax(rpn_cls.astype(jnp.float32), axis=-1)[..., 1]
-
-        def one(scores_i, box_i, info_i):
-            return propose(
-                scores_i, box_i, anchors, info_i,
-                pre_nms_top_n=self.test_pre_nms_top_n,
-                post_nms_top_n=self.test_post_nms_top_n,
-                nms_thresh=self.test_nms_thresh,
-                min_size=self.test_min_size,
-            )
-
-        rois, _, roi_valid = jax.vmap(one)(fg_scores, rpn_box, im_info)
+        rois, _, roi_valid = propose_batch(
+            fg_scores, rpn_box, anchors, im_info,
+            pre_nms_top_n=self.test_pre_nms_top_n,
+            post_nms_top_n=self.test_post_nms_top_n,
+            nms_thresh=self.test_nms_thresh,
+            min_size=self.test_min_size,
+        )
 
         def pool_one(feat_i, rois_i):
             return roi_align(feat_i, rois_i, self.pooled_size,

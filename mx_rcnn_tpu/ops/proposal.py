@@ -24,7 +24,42 @@ import jax
 import jax.numpy as jnp
 
 from mx_rcnn_tpu.ops.boxes import bbox_pred, clip_boxes
-from mx_rcnn_tpu.ops.nms import nms
+from mx_rcnn_tpu.ops.nms import nms, nms_batch
+
+
+def _decode_filter_topk(scores, bbox_deltas, anchors, im_info,
+                        pre_nms_top_n: int, min_size: int):
+    """Stages 1–3 of the proposal op for ONE image: decode + clip,
+    min-size filter, pre-NMS top-k.  Shared by the per-image and batched
+    paths so their pre-NMS candidate sets are identical by construction.
+
+    Returns (top_boxes (pre, 4), top_scores (pre,), top_valid (pre,))."""
+    n = scores.shape[0]
+    scores = scores.astype(jnp.float32)
+    # 1. decode + clip to the real image extent
+    proposals = bbox_pred(anchors, bbox_deltas.astype(jnp.float32))
+    proposals = clip_boxes(proposals, (im_info[0], im_info[1]))
+    # 2. min-size filter at input scale (ref: min_size * im_info[2])
+    ws = proposals[:, 2] - proposals[:, 0] + 1.0
+    hs = proposals[:, 3] - proposals[:, 1] + 1.0
+    min_sz = min_size * im_info[2]
+    size_ok = (ws >= min_sz) & (hs >= min_sz)
+    scores = jnp.where(size_ok, scores, -jnp.inf)
+    # 3. pre-NMS top-k (cap at N — small images have fewer anchors)
+    pre = min(pre_nms_top_n, n)
+    top_scores, top_idx = jax.lax.top_k(scores, pre)
+    return proposals[top_idx], top_scores, jnp.isfinite(top_scores)
+
+
+def _compact_rois(top_boxes, top_scores, keep_idx, keep_valid):
+    """Stage 5 for ONE image: gather NMS survivors into the fixed buffer,
+    filling padded slots with the best surviving box (slot 0 survives NMS
+    by construction whenever any valid proposal exists)."""
+    safe_idx = jnp.maximum(keep_idx, 0)
+    rois = top_boxes[safe_idx]
+    roi_scores = jnp.where(keep_valid, top_scores[safe_idx], 0.0)
+    rois = jnp.where(keep_valid[:, None], rois, rois[0][None, :])
+    return rois, roi_scores, keep_valid
 
 
 @functools.partial(
@@ -57,33 +92,15 @@ def propose(
       roi_scores: (post_nms_top_n,) their fg scores.
       roi_valid: (post_nms_top_n,) bool — False for padded slots.
     """
-    n = scores.shape[0]
-    scores = scores.astype(jnp.float32)
-    # 1. decode + clip to the real image extent
-    proposals = bbox_pred(anchors, bbox_deltas.astype(jnp.float32))
-    proposals = clip_boxes(proposals, (im_info[0], im_info[1]))
-    # 2. min-size filter at input scale (ref: min_size * im_info[2])
-    ws = proposals[:, 2] - proposals[:, 0] + 1.0
-    hs = proposals[:, 3] - proposals[:, 1] + 1.0
-    min_sz = min_size * im_info[2]
-    size_ok = (ws >= min_sz) & (hs >= min_sz)
-    scores = jnp.where(size_ok, scores, -jnp.inf)
-    # 3. pre-NMS top-k (cap at N — small images have fewer anchors than 12000)
-    pre = min(pre_nms_top_n, n)
-    top_scores, top_idx = jax.lax.top_k(scores, pre)
-    top_boxes = proposals[top_idx]
-    top_valid = jnp.isfinite(top_scores)
+    # stages 1–3 (decode+clip, min-size, top-k) shared with the batched path
+    top_boxes, top_scores, top_valid = _decode_filter_topk(
+        scores, bbox_deltas, anchors, im_info, pre_nms_top_n, min_size)
     # 4. NMS + fixed-size compaction
     keep_idx, keep_valid = nms(
         top_boxes, top_scores, nms_thresh, post_nms_top_n, valid=top_valid
     )
-    safe_idx = jnp.maximum(keep_idx, 0)
-    rois = top_boxes[safe_idx]
-    roi_scores = jnp.where(keep_valid, top_scores[safe_idx], 0.0)
-    # 5. fill padded slots with the best surviving box (slot 0 survives NMS
-    #    by construction whenever any valid proposal exists)
-    rois = jnp.where(keep_valid[:, None], rois, rois[0][None, :])
-    return rois, roi_scores, keep_valid
+    # 5. fill padded slots (see _compact_rois)
+    return _compact_rois(top_boxes, top_scores, keep_idx, keep_valid)
 
 
 def propose_batch(
@@ -91,11 +108,36 @@ def propose_batch(
     bbox_deltas: jnp.ndarray,
     anchors: jnp.ndarray,
     im_info: jnp.ndarray,
+    batched_nms: bool = True,
     **kw,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """vmap of :func:`propose` over a leading batch axis.
+    """Batched :func:`propose` over a leading batch axis.
 
     scores (B, N), bbox_deltas (B, N, 4), im_info (B, 3); anchors shared.
+
+    With ``batched_nms=True`` (the default — the r6 production path) the
+    per-image stages (decode/top-k/compaction) run under vmap but the NMS
+    sweep runs as ONE cross-image batched pass (:func:`nms_batch`),
+    decision-exact vs ``vmap(propose)`` (pinned by
+    ``tests/test_proposal.py``).  ``batched_nms=False`` restores the pure
+    vmap-of-propose composition — kept as the A/B arm for
+    ``tools/profile_step.py --nms_mode per_image``.
     """
-    fn = functools.partial(propose, **kw)
-    return jax.vmap(fn, in_axes=(0, 0, None, 0))(scores, bbox_deltas, anchors, im_info)
+    if not batched_nms:
+        fn = functools.partial(propose, **kw)
+        return jax.vmap(fn, in_axes=(0, 0, None, 0))(
+            scores, bbox_deltas, anchors, im_info)
+    pre_nms_top_n = kw.pop("pre_nms_top_n", 6000)
+    post_nms_top_n = kw.pop("post_nms_top_n", 300)
+    nms_thresh = kw.pop("nms_thresh", 0.7)
+    min_size = kw.pop("min_size", 16)
+    if kw:
+        raise TypeError(f"unknown propose_batch kwargs {sorted(kw)}")
+    top_boxes, top_scores, top_valid = jax.vmap(
+        lambda s, d, i: _decode_filter_topk(s, d, anchors, i,
+                                            pre_nms_top_n, min_size)
+    )(scores, bbox_deltas, im_info)
+    keep_idx, keep_valid = nms_batch(
+        top_boxes, top_scores, nms_thresh, post_nms_top_n, valid=top_valid)
+    return jax.vmap(_compact_rois)(top_boxes, top_scores, keep_idx,
+                                   keep_valid)

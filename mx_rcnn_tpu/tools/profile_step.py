@@ -21,6 +21,16 @@ killed the round-2 fori_loop methodology).  Unrolling sidesteps the loop
 op entirely at the cost of compile time linear in N — hence the default
 N of 8; raise ``--iters`` on fast-compiling devices for tighter numbers.
 
+r6 additions: the lever A/B switches (``--roi_backend blocked
+--roi_chunk 64`` for the ROI-chunked blocked ROIAlign, ``--nms_mode
+per_image`` for the pre-batched proposal sweep, ``--shape 640x1024`` for
+the sublane-friendly bucket arm — `script/perf_r6.sh` drives the full
+battery incl. the batch-8 stage table), per-stage gauges into the obs
+registry (``profile/stage_ms/*`` — stage tables land in the unified
+/metrics view and runrec summaries), and ``--check`` (the `make
+perf-smoke` self-test: finite stages, ZERO timed-pass recompiles, chain
+self-check).
+
 Usage:
   python -m mx_rcnn_tpu.tools.profile_step --network resnet101 --iters 8
 """
@@ -87,6 +97,34 @@ def main(argv=None) -> None:
     p.add_argument("--prenms", type=int, default=None,
                    help="override TRAIN rpn_pre_nms_top_n (the adopted "
                         "recipe is 6000; the config ships the ref 12000)")
+    p.add_argument("--roi_backend", default="auto",
+                   choices=("auto", "jnp", "blocked", "pallas"),
+                   help="ROIAlign backend for the roi_align stage AND the "
+                        "full step (cfg.train.roi_align_backend) — the r6 "
+                        "blocked-vs-einsum A/B arm switch")
+    p.add_argument("--roi_chunk", type=int, default=64,
+                   help="ROI block size for --roi_backend blocked")
+    p.add_argument("--nms_mode", default="batched",
+                   choices=("batched", "per_image"),
+                   help="proposal-stage NMS composition: 'batched' (one "
+                        "cross-image tile sweep when the jnp backend is "
+                        "selected) or 'per_image' (vmap of per-image "
+                        "sweeps — the pre-r6 composition)")
+    p.add_argument("--nms_backend", default="auto",
+                   choices=("auto", "jnp", "pallas"),
+                   help="suppression-sweep backend (ops/nms.py "
+                        "set_nms_backend).  NOTE on TPU 'auto' resolves "
+                        "to the per-image Pallas kernel in BOTH "
+                        "--nms_mode arms (lane/VMEM guards permitting), "
+                        "so the batched-sweep A/B must force 'jnp' to "
+                        "engage the cross-image sweep — script/perf_r6.sh "
+                        "leg 3 runs the 3-arm comparison")
+    p.add_argument("--check", action="store_true",
+                   help="perf-smoke self-test: assert the chain "
+                        "self-check (sum of stages ~ full step), zero "
+                        "recompiles on every timed pass, and the stage "
+                        "gauges landing in the obs registry; exits "
+                        "non-zero on violation")
     args = p.parse_args(argv)
 
     import jax
@@ -95,15 +133,22 @@ def main(argv=None) -> None:
     from mx_rcnn_tpu.config import generate_config
     from mx_rcnn_tpu.core.train import make_train_step, setup_training
     from mx_rcnn_tpu.models import build_model
-    from mx_rcnn_tpu.ops.proposal import propose
-    from mx_rcnn_tpu.ops.roi_pool import roi_align
+    from mx_rcnn_tpu.obs.metrics import LoweringCounter, registry
+    from mx_rcnn_tpu.ops.nms import set_nms_backend
+    from mx_rcnn_tpu.ops.proposal import propose_batch
+    from mx_rcnn_tpu.ops.roi_pool import roi_align_batched
     from mx_rcnn_tpu.ops.targets import anchor_target, proposal_target
+
+    set_nms_backend(args.nms_backend)
 
     h, w = (int(v) for v in args.shape.split("x"))
     n = args.batch_images
     N = args.iters
     cfg = generate_config(args.network, args.dataset)
-    cfg = cfg.replace_in("train", batch_images=n)
+    cfg = cfg.replace_in("train", batch_images=n,
+                         roi_align_backend=args.roi_backend,
+                         roi_align_chunk=args.roi_chunk,
+                         nms_batched=args.nms_mode == "batched")
     if args.prenms is not None:
         cfg = cfg.replace_in("train", rpn_pre_nms_top_n=args.prenms)
     model = build_model(cfg)
@@ -145,6 +190,22 @@ def main(argv=None) -> None:
                     raise
                 time.sleep(5.0)
 
+    # stage table accounting: per-stage ms land in the process obs
+    # registry (gauges under profile/stage_ms/* — the unified /metrics
+    # view and runrec summaries pick them up) and in ``stage_ms`` for the
+    # --check self-test; ``relowerings`` counts jit cache misses on the
+    # TIMED pass of each stage (the warm pass must never retrace).
+    stage_ms: dict = {}
+    relowerings: dict = {}
+
+    def record_stage(label, per_s, lowerings=0):
+        ms = per_s * 1e3
+        slug = "".join(ch if ch.isalnum() else "_" for ch in label.lower())
+        slug = "_".join(filter(None, slug.split("_")))
+        stage_ms[label] = ms
+        relowerings[label] = lowerings
+        registry().set_gauge(f"profile/stage_ms/{slug}", round(ms, 4))
+
     def timed_loop(stage, label, note=""):
         """stage: carry (f32 scalar) -> carry.  Runs N reps in one program,
         UNROLLED (no fori_loop — see module docstring); the carry chain is
@@ -157,10 +218,12 @@ def main(argv=None) -> None:
 
         looped = jax.jit(chain)
         retry_compile(lambda: fetch(looped(jnp.float32(0))))  # compile+warm
-        t0 = time.perf_counter()
-        fetch(looped(jnp.float32(0)))
-        per = (time.perf_counter() - t0 - rtt) / N
+        with LoweringCounter() as lc:
+            t0 = time.perf_counter()
+            fetch(looped(jnp.float32(0)))
+            per = (time.perf_counter() - t0 - rtt) / N
         print(f"{label:<34s} {per * 1e3:9.2f} ms  {note}", flush=True)
+        record_stage(label, per, lc.n)
         return per
 
     def carry_of(x):
@@ -195,23 +258,24 @@ def main(argv=None) -> None:
     fg = jax.nn.softmax(rpn_cls.astype(jnp.float32), axis=-1)[..., 1]
     box32 = rpn_box.astype(jnp.float32)
 
-    prop_one = functools.partial(
-        propose, pre_nms_top_n=tr.rpn_pre_nms_top_n,
+    prop_fn = functools.partial(
+        propose_batch, batched_nms=tr.nms_batched,
+        pre_nms_top_n=tr.rpn_pre_nms_top_n,
         post_nms_top_n=tr.rpn_post_nms_top_n,
         nms_thresh=tr.rpn_nms_thresh, min_size=tr.rpn_min_size)
 
     def prop_stage(c):
-        rois, _, _ = jax.vmap(prop_one, in_axes=(0, 0, None, 0))(
-            fg + c * eps, box32, anchors, batch.im_info)
+        rois, _, _ = prop_fn(fg + c * eps, box32, anchors, batch.im_info)
         return carry_of(rois)
 
     t_prop = timed_loop(prop_stage, "proposal (decode+topk+NMS)",
                         f"pre={tr.rpn_pre_nms_top_n} "
-                        f"post={tr.rpn_post_nms_top_n}")
+                        f"post={tr.rpn_post_nms_top_n} "
+                        f"nms={args.nms_mode}/{args.nms_backend}")
 
-    rois, _, rois_valid = retry_compile(jax.jit(jax.vmap(
-        prop_one, in_axes=(0, 0, None, 0))), fg, box32, anchors,
-        batch.im_info)
+    rois, _, rois_valid = retry_compile(
+        jax.jit(lambda s, d, i: prop_fn(s, d, anchors, i)),
+        fg, box32, batch.im_info)
 
     at_one = functools.partial(
         anchor_target, rpn_batch_size=tr.rpn_batch_size,
@@ -250,17 +314,24 @@ def main(argv=None) -> None:
                        batch.gt_boxes, batch.gt_classes, batch.gt_valid,
                        keys)
 
+    # the stage runs whatever backend the full step will run
+    # (cfg.train.roi_align_backend — 'auto' resolves like core/train)
+    ra_backend = None if tr.roi_align_backend == "auto" \
+        else tr.roi_align_backend
+    ra_fn = functools.partial(
+        roi_align_batched, output_size=model.pooled_size,
+        spatial_scale=1.0 / model.feat_stride, backend=ra_backend,
+        chunk=tr.roi_align_chunk)
+
     def ra_stage(c):
-        pooled = jax.vmap(lambda f, r: roi_align(
-            f, r, model.pooled_size, 1.0 / model.feat_stride))(
-                feat + c * eps.astype(feat.dtype), pt.rois)
+        pooled = ra_fn(feat + c * eps.astype(feat.dtype), pt.rois)
         return carry_of(pooled)
 
     t_ra = timed_loop(ra_stage, "roi_align",
-                      f"rois={pt.rois.shape[0] * pt.rois.shape[1]}")
+                      f"rois={pt.rois.shape[0] * pt.rois.shape[1]} "
+                      f"backend={tr.roi_align_backend}")
 
-    pooled = retry_compile(jax.jit(jax.vmap(lambda f, r: roi_align(
-        f, r, model.pooled_size, 1.0 / model.feat_stride))), feat, pt.rois)
+    pooled = retry_compile(jax.jit(ra_fn), feat, pt.rois)
     flat = pooled.reshape((-1,) + pooled.shape[2:])
 
     def head_stage(c):
@@ -322,16 +393,24 @@ def main(argv=None) -> None:
         lambda: step(jax.tree.map(jnp.copy, state), batch, key))[0]
     s, metrics = step(s, batch, key)
     fetch(metrics["loss"])
-    t0 = time.perf_counter()
-    for _ in range(N):
-        s, metrics = step(s, batch, key)
-    fetch(metrics["loss"])
-    t_full = (time.perf_counter() - t0 - rtt) / N
+    with LoweringCounter() as lc_full:
+        t0 = time.perf_counter()
+        for _ in range(N):
+            s, metrics = step(s, batch, key)
+        fetch(metrics["loss"])
+        t_full = (time.perf_counter() - t0 - rtt) / N
     print(f"{'FULL train step (donated)':<34s} {t_full * 1e3:9.2f} ms  "
           f"imgs/s/chip={n / t_full:.1f}", flush=True)
+    record_stage("FULL train step (donated)", t_full, lc_full.n)
 
     acct = t_feat_bwd + t_prop + t_at + t_pt + t_ra + t_head
     print(f"{'sum of pieces (approx)':<34s} {acct * 1e3:9.2f} ms", flush=True)
+    record_stage("sum of pieces (approx)", acct)
+    registry().set_gauge("profile/self_check_ratio",
+                         round(acct / t_full, 4) if t_full > 0 else -1.0)
+
+    if args.check:
+        _run_check(stage_ms, relowerings, acct, t_full)
 
     if args.trace_dir:
         import jax.profiler
@@ -343,6 +422,53 @@ def main(argv=None) -> None:
         print(f"trace written to {args.trace_dir}", file=sys.stderr)
         if args.trace_summary:
             summarize_trace(args.trace_dir)
+
+
+def _run_check(stage_ms: dict, relowerings: dict, acct: float,
+               t_full: float) -> None:
+    """`--check` (make perf-smoke): assert the profiler's own invariants —
+    every stage measured finite, NO stage retraced on its timed pass, the
+    chain self-check holds (sum of the six component stages lands in the
+    same ballpark as the full step; wide band because a contended CPU box
+    adds multiplicative noise and XLA overlaps stages in-program), and the
+    per-stage gauges landed in the obs registry.  Raises SystemExit(1) on
+    the first violation so `make test-gate` fails loudly."""
+    import math
+
+    from mx_rcnn_tpu.obs.metrics import registry
+
+    failures = []
+    for label, ms in stage_ms.items():
+        if not math.isfinite(ms):
+            failures.append(f"stage {label!r} not finite: {ms}")
+    for label, lows in relowerings.items():
+        if lows:
+            failures.append(
+                f"stage {label!r} lowered {lows} new program(s) on its "
+                f"timed pass (jit cache miss — the chain retraced)")
+    if t_full <= 0:
+        failures.append(f"full step non-positive: {t_full * 1e3:.3f} ms")
+    elif not 0.1 <= acct / t_full <= 10.0:
+        # an order of magnitude each way: the check catches structural
+        # breakage (a stage timing garbage, RTT subtraction gone wrong),
+        # not noise — a contended 1-core box was measured swinging the
+        # ratio 0.28–0.42 run to run on the tiny model
+        failures.append(
+            f"chain self-check failed: sum of stages {acct * 1e3:.2f} ms "
+            f"vs full step {t_full * 1e3:.2f} ms (ratio "
+            f"{acct / t_full:.2f} outside [0.1, 10])")
+    snap = registry().snapshot()
+    gauges = snap.get("gauges", {})
+    missing = [k for k in ("profile/stage_ms/full_train_step_donated",
+                           "profile/self_check_ratio") if k not in gauges]
+    if missing:
+        failures.append(f"obs registry gauges missing: {missing}")
+    if failures:
+        for f in failures:
+            print(f"CHECK FAIL: {f}", file=sys.stderr)
+        raise SystemExit(1)
+    print(f"CHECK OK: {len(stage_ms)} stages, zero timed-pass recompiles, "
+          f"self-check ratio {acct / t_full:.2f}", flush=True)
 
 
 def summarize_trace(trace_dir: str, top: int = 15) -> None:

@@ -46,3 +46,31 @@ def test_shifted_anchor_layout():
 def test_shifted_anchor_count_stride8():
     a = generate_shifted_anchors(4, 4, feat_stride=8, scales=(4,))
     assert a.shape == (4 * 4 * 3, 4)
+
+
+def test_sublane_bucket_640x1024_regenerates_valid_anchors():
+    """r6 bucket experiment: switching the bucket to 640x1024 (40x64
+    stride-16 grid — 40 is a whole number of 8-row sublanes, unlike the
+    default 38) must regenerate anchors automatically and validly; the
+    config override path is what script/perf_r6.sh leg 4 exercises."""
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.data.image import choose_bucket
+
+    cfg = generate_config(
+        "resnet101", "coco",
+        bucket__shapes=[[640, 1024], [1024, 640]])
+    assert cfg.bucket.shapes == ((640, 1024), (1024, 640))
+    for h, w in cfg.bucket.shapes:
+        assert h % 32 == 0 and w % 32 == 0  # feature grid stays aligned
+        fh, fw = h // 16, w // 16
+        a = generate_shifted_anchors(fh, fw, 16)
+        assert a.shape == (fh * fw * 9, 4)
+        assert np.isfinite(a).all()
+        # grid covers the full bucket: last cell's base anchor sits at
+        # ((fw-1)*16, (fh-1)*16) offset from the golden base anchor
+        np.testing.assert_allclose(
+            a[-9] - GOLDEN[0],
+            [(fw - 1) * 16, (fh - 1) * 16, (fw - 1) * 16, (fh - 1) * 16])
+    assert 640 // 16 == 40 and 40 % 8 == 0  # the sublane-friendly point
+    # a landscape VOC-scale image routes into the landscape bucket
+    assert choose_bucket(600, 1000, cfg.bucket.shapes) == (640, 1024)

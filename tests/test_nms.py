@@ -9,7 +9,7 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
-from mx_rcnn_tpu.ops.nms import nms, nms_mask
+from mx_rcnn_tpu.ops.nms import nms, nms_batch, nms_mask, nms_mask_batch
 
 
 def greedy_nms_oracle(boxes, scores, thresh):
@@ -209,3 +209,99 @@ def test_nms_backend_tie_rich_ap_bound():
     # Real eval sets (4952 VOC images) average further still.
     assert max(deltas) < 0.05, deltas
     assert float(np.mean(deltas)) < 0.02, deltas
+
+
+# ---------------------------------------------------------------------------
+# Cross-image batched sweep (r6 tentpole): nms_batch / nms_mask_batch run
+# B images through ONE tile-sweep loop nest.  Contract: decision-exact per
+# image against the per-image sweep (which the oracle tests above pin to
+# sequential greedy NMS) — every output array identical.
+# ---------------------------------------------------------------------------
+
+def _batch_boxes(rng, b, n, span=200):
+    boxes = np.stack([random_boxes(rng, n, span)[0] for _ in range(b)])
+    scores = np.stack([random_boxes(rng, n, span)[1] for _ in range(b)])
+    return boxes, scores
+
+
+@pytest.mark.parametrize("n,tile", [(17, 64), (64, 64), (300, 64),
+                                    (128, 128), (256, 128), (777, 256)])
+def test_nms_batch_matches_per_image(rng, n, tile):
+    """Shape sweep including K exactly at the lane-guard boundary
+    (K=128=tile: single-tile peeled path; K=256, tile 128: the guard's
+    k % tile == 0 case) and padded odd K."""
+    boxes, scores = _batch_boxes(rng, 4, n)
+    idx_b, val_b = nms_batch(jnp.asarray(boxes), jnp.asarray(scores), 0.5,
+                             n, tile_size=tile)
+    for i in range(4):
+        idx_i, val_i = nms(jnp.asarray(boxes[i]), jnp.asarray(scores[i]),
+                           0.5, n, tile_size=tile)
+        np.testing.assert_array_equal(np.asarray(idx_b[i]),
+                                      np.asarray(idx_i))
+        np.testing.assert_array_equal(np.asarray(val_b[i]),
+                                      np.asarray(val_i))
+
+
+def test_nms_batch_matches_oracle_rows(rng):
+    """Each row of the batched result equals the sequential greedy NumPy
+    oracle directly (not only via the per-image implementation)."""
+    boxes, scores = _batch_boxes(rng, 6, 97)
+    idx_b, val_b = nms_batch(jnp.asarray(boxes), jnp.asarray(scores), 0.4,
+                             97, tile_size=64)
+    for i in range(6):
+        want = greedy_nms_oracle(boxes[i], scores[i], 0.4)
+        got = list(np.asarray(idx_b[i][val_b[i]]))
+        assert got == want
+
+
+def test_nms_mask_batch_matches_per_image(rng):
+    boxes, scores = _batch_boxes(rng, 5, 120)
+    mask_b = nms_mask_batch(jnp.asarray(boxes), jnp.asarray(scores), 0.4,
+                            tile_size=64)
+    for i in range(5):
+        mask_i = nms_mask(jnp.asarray(boxes[i]), jnp.asarray(scores[i]),
+                          0.4, tile_size=64)
+        np.testing.assert_array_equal(np.asarray(mask_b[i]),
+                                      np.asarray(mask_i))
+
+
+def test_nms_batch_tie_cases():
+    """Tie-break parity: exact-duplicate boxes at tied scores and
+    quantized score levels — the batched sweep must make the SAME
+    tie decisions (same sorted order, same suppressor) per image."""
+    rng = np.random.RandomState(11)
+    b, n = 4, 80
+    boxes = np.zeros((b, n, 4), np.float32)
+    scores = np.zeros((b, n), np.float32)
+    for i in range(b):
+        bx, _ = random_boxes(rng, n // 2)
+        # every box duplicated, every score snapped to 8 levels
+        boxes[i] = np.concatenate([bx, bx])
+        scores[i] = np.repeat(rng.randint(1, 9, n // 2) / 8.0,
+                              2).astype(np.float32)
+    idx_b, val_b = nms_batch(jnp.asarray(boxes), jnp.asarray(scores), 0.3,
+                             n, tile_size=64)
+    mask_b = nms_mask_batch(jnp.asarray(boxes), jnp.asarray(scores), 0.3,
+                            tile_size=64)
+    for i in range(b):
+        idx_i, val_i = nms(jnp.asarray(boxes[i]), jnp.asarray(scores[i]),
+                           0.3, n, tile_size=64)
+        np.testing.assert_array_equal(np.asarray(idx_b[i]),
+                                      np.asarray(idx_i))
+        mask_i = nms_mask(jnp.asarray(boxes[i]), jnp.asarray(scores[i]),
+                          0.3, tile_size=64)
+        np.testing.assert_array_equal(np.asarray(mask_b[i]),
+                                      np.asarray(mask_i))
+
+
+def test_nms_batch_max_output_and_valid(rng):
+    boxes, scores = _batch_boxes(rng, 3, 200)
+    valid = np.ones((3, 200), bool)
+    valid[:, 150:] = False
+    idx_b, val_b = nms_batch(jnp.asarray(boxes), jnp.asarray(scores), 0.5,
+                             10, valid=jnp.asarray(valid))
+    for i in range(3):
+        want = greedy_nms_oracle(boxes[i][:150], scores[i][:150], 0.5)[:10]
+        got = list(np.asarray(idx_b[i][val_b[i]]))
+        assert got == want
+        assert all(g < 150 for g in got)

@@ -18,3 +18,31 @@ def test_profile_step_smoke(capsys):
                   "full loss fwd+bwd (no update)", "optimizer update",
                   "FULL train step (donated)"):
         assert label in out, out
+
+
+def test_profile_step_check_mode_ab_flags_and_obs_gauges(capsys):
+    """The r6 additions in one pass: the A/B arm flags (--roi_backend
+    blocked, --nms_mode per_image) trace+run, --check passes its own
+    self-test (finite stages, zero timed-pass recompiles, chain
+    self-check), and the per-stage gauges land in the process obs
+    registry under profile/stage_ms/* (the make perf-smoke contract,
+    exercised here on the non-default arms)."""
+    from mx_rcnn_tpu.obs.metrics import registry
+
+    registry().reset("profile/")
+    main(["--network", "tiny", "--dataset", "synthetic",
+          "--shape", "128x160", "--batch_images", "2", "--iters", "2",
+          "--check", "--roi_backend", "blocked", "--roi_chunk", "8",
+          "--nms_mode", "per_image"])
+    out = capsys.readouterr().out
+    assert "CHECK OK" in out, out
+    assert "backend=blocked" in out, out
+    assert "nms=per_image" in out, out
+    gauges = registry().snapshot()["gauges"]
+    for key in ("profile/stage_ms/backbone_fwd",
+                "profile/stage_ms/roi_align",
+                "profile/stage_ms/proposal_decode_topk_nms",
+                "profile/stage_ms/full_train_step_donated",
+                "profile/self_check_ratio"):
+        assert key in gauges, sorted(gauges)
+        assert gauges[key] == gauges[key]  # not NaN

@@ -63,3 +63,32 @@ def test_propose_batch_vmap():
                                     pre_nms_top_n=200, post_nms_top_n=30)
     assert rois.shape == (2, 30, 4)
     np.testing.assert_allclose(np.asarray(rois[0]), np.asarray(rois[1]), rtol=1e-5)
+
+
+def test_propose_batch_batched_nms_decision_exact_vs_vmap():
+    """The r6 cross-image batched NMS path must equal the vmap-of-propose
+    composition on EVERY output — jitted whole (the production context;
+    eager dispatch can differ by 1 ulp in fused decode arithmetic, which
+    is a dispatch artifact, not a decision difference)."""
+    import jax
+
+    anchors, scores, deltas, im_info = setup_inputs()
+    rng = np.random.RandomState(3)
+    b = 4
+    b_scores = jnp.stack([scores * float(s)
+                          for s in rng.uniform(0.2, 1.0, b)])
+    b_deltas = jnp.stack([deltas + float(d)
+                          for d in rng.uniform(-0.1, 0.1, b)])
+    b_info = jnp.tile(im_info[None], (b, 1))
+    kw = dict(pre_nms_top_n=200, post_nms_top_n=30, nms_thresh=0.7,
+              min_size=4)
+
+    per_image = jax.jit(lambda s, d, i: propose_batch(
+        s, d, anchors, i, batched_nms=False, **kw))
+    batched = jax.jit(lambda s, d, i: propose_batch(
+        s, d, anchors, i, **kw))
+    a = per_image(b_scores, b_deltas, b_info)
+    g = batched(b_scores, b_deltas, b_info)
+    for x, y, name in zip(a, g, ("rois", "scores", "valid")):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=name)

@@ -180,3 +180,138 @@ def test_roi_align_pallas_rois_grad_is_explicit_zeros():
     assert g_rois.dtype == rois.dtype
     assert not np.any(np.asarray(g_rois))
     assert np.any(np.asarray(g_feat))
+
+
+# ---------------------------------------------------------------------------
+# Blocked ROIAlign (r6 tentpole, ops/roi_pool.py — roi_align_blocked): the
+# einsum pair run lax.map-chunked over ROIs, bit-equal forward (the ROI
+# axis is a batch axis of both contractions — chunking it cannot change any
+# per-element reduction), custom-VJP backward blocked the same way.
+# ---------------------------------------------------------------------------
+
+from mx_rcnn_tpu.ops.roi_pool import roi_align_batched, roi_align_blocked
+
+
+@pytest.mark.parametrize("r,chunk", [(13, 4), (8, 8), (5, 64), (1, 4)])
+def test_roi_align_blocked_forward_bit_equal_fp32(r, chunk):
+    """Odd ROI counts vs chunk size: forward must be BIT-equal to the
+    einsum pair, including when padding rounds R up and when one chunk
+    covers everything."""
+    rng = np.random.RandomState(0)
+    feat = jnp.asarray(rng.randn(19, 32, 16).astype(np.float32))
+    rois = jnp.asarray(_rand_rois(rng, 1, r, 19 * 16, 32 * 16)[0])
+    want = roi_align(feat, rois, (7, 7), 1 / 16.0)
+    got = roi_align_blocked(feat, rois, (7, 7), 1 / 16.0, 2, chunk)
+    assert got.dtype == want.dtype
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_roi_align_blocked_forward_bit_equal_bf16():
+    """The bf16 fast path (default precision) is chunked identically."""
+    rng = np.random.RandomState(1)
+    feat = jnp.asarray(rng.randn(24, 16, 8).astype(np.float32),
+                       jnp.bfloat16)
+    rois = jnp.asarray(_rand_rois(rng, 1, 11, 24 * 16, 16 * 16)[0])
+    want = roi_align(feat, rois, (7, 7), 1 / 16.0)
+    got = roi_align_blocked(feat, rois, (7, 7), 1 / 16.0, 2, 4)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(want.astype(jnp.float32)),
+        np.asarray(got.astype(jnp.float32)))
+
+
+def _dyadic_case():
+    """Inputs on which every product and partial sum is exactly
+    representable (small integers, power-of-two ROI geometry at pooled
+    size 4 → dyadic bilinear weights): fp addition is then associative,
+    so chunked and monolithic backward reductions must agree BIT-for-bit
+    — this pins the contract (same math) independently of XLA's
+    reduction-order freedom on general inputs."""
+    rng = np.random.RandomState(2)
+    feat = rng.randint(-4, 5, (16, 16, 8)).astype(np.float32)
+    rois = np.array([[0, 0, 64, 64], [16, 32, 80, 96], [8, 8, 40, 72],
+                     [32, 0, 96, 32], [0, 16, 32, 48]], np.float32)
+    cot = rng.randint(-2, 3, (5, 4, 4, 8)).astype(np.float32)
+    return feat, rois, cot
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_roi_align_blocked_grads_bit_equal_exact_vectors(dtype):
+    """Custom-VJP grads vs einsum autodiff, both dtype paths, BIT-equal
+    on reduction-order-insensitive vectors (odd chunking: 5 ROIs, chunk
+    2 → 3 chunks with padding)."""
+    feat_np, rois_np, cot_np = _dyadic_case()
+    feat = jnp.asarray(feat_np).astype(
+        jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    rois, cot = jnp.asarray(rois_np), jnp.asarray(cot_np)
+
+    g_ein = jax.grad(lambda f: jnp.sum(
+        roi_align(f, rois, (4, 4), 1 / 16.0).astype(jnp.float32)
+        * cot))(feat)
+    g_blk = jax.grad(lambda f: jnp.sum(
+        roi_align_blocked(f, rois, (4, 4), 1 / 16.0, 2,
+                          2).astype(jnp.float32) * cot))(feat)
+    assert g_blk.dtype == g_ein.dtype
+    np.testing.assert_array_equal(
+        np.asarray(g_ein.astype(jnp.float32)),
+        np.asarray(g_blk.astype(jnp.float32)))
+
+
+def test_roi_align_blocked_grads_close_random():
+    """On general random vectors the chunked backward accumulates the
+    same sum in a different association — grads agree to float tolerance
+    (measured ~1 ulp of O(1) values), while the FORWARD stays bit-equal
+    even here."""
+    rng = np.random.RandomState(3)
+    feat = jnp.asarray(rng.randn(19, 32, 16).astype(np.float32))
+    rois = jnp.asarray(_rand_rois(rng, 1, 13, 19 * 16, 32 * 16)[0])
+    cot = jnp.asarray(rng.randn(13, 7, 7, 16).astype(np.float32))
+
+    g_ein = jax.grad(lambda f: jnp.sum(
+        roi_align(f, rois, (7, 7), 1 / 16.0) * cot))(feat)
+    g_blk = jax.grad(lambda f: jnp.sum(
+        roi_align_blocked(f, rois, (7, 7), 1 / 16.0, 2, 4) * cot))(feat)
+    np.testing.assert_allclose(np.asarray(g_blk), np.asarray(g_ein),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_roi_align_blocked_single_chunk_grads_bit_equal_random():
+    """chunk >= R is ONE chunk of the identical einsums — grads bit-equal
+    even on random vectors (no cross-chunk accumulation exists)."""
+    rng = np.random.RandomState(4)
+    feat = jnp.asarray(rng.randn(12, 20, 8).astype(np.float32))
+    rois = jnp.asarray(_rand_rois(rng, 1, 7, 12 * 16, 20 * 16)[0])
+    cot = jnp.asarray(rng.randn(7, 7, 7, 8).astype(np.float32))
+    g_ein = jax.grad(lambda f: jnp.sum(
+        roi_align(f, rois, (7, 7), 1 / 16.0) * cot))(feat)
+    g_blk = jax.grad(lambda f: jnp.sum(
+        roi_align_blocked(f, rois, (7, 7), 1 / 16.0, 2, 64) * cot))(feat)
+    np.testing.assert_array_equal(np.asarray(g_ein), np.asarray(g_blk))
+
+
+def test_roi_align_blocked_rois_grad_is_explicit_zeros():
+    """Same contract as the Pallas backend (and the reference ROIPooling):
+    rois are non-differentiable data — zeros cotangent, clean trace."""
+    rng = np.random.RandomState(5)
+    feat = jnp.asarray(rng.randn(8, 8, 16).astype(np.float32))
+    rois = jnp.asarray(_rand_rois(rng, 1, 4, 128, 128)[0])
+    g_feat, g_rois = jax.grad(
+        lambda f, b: jnp.sum(roi_align_blocked(f, b, (7, 7), 1 / 16.0, 2,
+                                               2)),
+        argnums=(0, 1))(feat, rois)
+    assert g_rois.shape == rois.shape
+    assert not np.any(np.asarray(g_rois))
+    assert np.any(np.asarray(g_feat))
+
+
+def test_roi_align_batched_blocked_dispatch():
+    """backend='blocked' routes through roi_align_blocked and matches the
+    default batched einsum path bit-for-bit."""
+    rng = np.random.RandomState(6)
+    feat = jnp.asarray(rng.randn(2, 9, 12, 8).astype(np.float32))
+    rois = jnp.asarray(_rand_rois(rng, 2, 5, 9 * 16, 12 * 16))
+    want = roi_align_batched(feat, rois, (7, 7), 1 / 16.0)
+    got = roi_align_batched(feat, rois, (7, 7), 1 / 16.0,
+                            backend="blocked", chunk=2)
+    assert got.shape == (2, 5, 7, 7, 8)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
