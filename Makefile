@@ -14,7 +14,7 @@ NATIVE_LIB := $(NATIVE_DIR)/libmxrcnn_native.so
 NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 
 .PHONY: all native lint test test-all test-gate serve-smoke ft-smoke \
-	obs-smoke perf-smoke clean
+	obs-smoke perf-smoke elastic-smoke clean
 
 all: native
 
@@ -81,14 +81,26 @@ perf-smoke:
 ft-smoke:
 	python -m mx_rcnn_tpu.tools.crashloop --smoke --check --skip_overhead
 
+# elastic smoke (docs/FT.md "Elasticity"): a 2-process jax.distributed
+# CPU world loses one process to SIGTERM mid-epoch, shrinks onto the
+# survivor's device set (grad-accum rescaled so the global batch stays
+# on-recipe), resumes stepping, grows the world back to 2 processes and
+# finishes — fails unless the merged runrec/ELASTIC_EVENT timeline shows
+# the shrink + grow, every restore is bit-identical to its checkpoint,
+# and ZERO programs lowered after any generation's first step.  ~3 min
+# warm (world relaunches share the XLA compile cache).
+elastic-smoke:
+	python -m mx_rcnn_tpu.tools.crashloop --elastic --smoke --check
+
 # the two end-metric gates (30-epoch gauntlet seed-0 from scratch
 # ~22 min, 16-device hierarchical dryrun ~7 min on one core) — run
 # these for round-gate evidence; test-all stays green without them.
 # graphlint runs first: a hygiene violation fails the gate in seconds
 # instead of after 30 minutes of training; serve-smoke next (~30 s),
 # then the perf-tooling smoke (~1 min), the observability smoke
-# (~1 min) and the 2-kill crash loop (ft-smoke, ~2 min)
-test-gate: lint serve-smoke perf-smoke obs-smoke ft-smoke
+# (~1 min), the 2-kill crash loop (ft-smoke, ~2 min) and the elastic
+# shrink/grow storm (elastic-smoke, ~3 min)
+test-gate: lint serve-smoke perf-smoke obs-smoke ft-smoke elastic-smoke
 	python -m pytest tests/ -x -q -m "gate"
 
 clean:

@@ -301,6 +301,50 @@ class FTConfig:
     # long runs.  keep_last=0 disables GC entirely.
     keep_last: int = 3
     keep_every: int = 1
+    # ``--resume auto`` HARD-FAILS when the checkpoint manifest records a
+    # different effective global batch (device count x batch_images x
+    # grad_accum) than this run would train with — a silent batch change
+    # alters the LR-schedule semantics and the experiment.  True downgrades
+    # the error to a WARNING; the elastic controller (ft/elastic.py) sets
+    # it for its own supervised restores, where the resize is the point.
+    allow_resize_resume: bool = False
+
+
+@dataclass(frozen=True)
+class ElasticConfig:
+    """TPU addition (no reference equivalent — the reference assumes a
+    fixed device set for the whole run): policy knobs for the
+    ``mx_rcnn_tpu/ft/elastic.py`` elastic run controller (docs/FT.md
+    "Elasticity"), which turns preemption into a mesh resize: drain →
+    restore the latest valid checkpoint onto the new mesh → rescale
+    grad accumulation so the effective global batch stays on-recipe →
+    keep stepping.
+
+    Same 3-level precedence as every section (hardcoded defaults <
+    presets < ``--set elastic__field=value`` CLI overrides).
+    """
+
+    # master switch (tools/train.py --elastic): wrap training in the
+    # generation loop that watches topology directives and resizes live
+    enabled: bool = False
+    # the RECIPE's reference device count: effective global batch =
+    # base_devices x batch_images (x process count folded in).  A mesh of
+    # K devices trains with grad_accum = base_devices / K so the
+    # optimizer-step cadence and LR schedule never leave the recipe.
+    # 0 = adopt the first generation's device count as the base.
+    base_devices: int = 0
+    # where topology directives land ("" = <prefix>.topology.json); the
+    # supervisor (or any scheduler) atomically writes
+    # {"generation": G, "num_devices": D, "num_processes": P} here and
+    # optionally SIGUSR1s the process to poll immediately
+    topology_path: str = ""
+    # directive poll cadence in optimizer steps (a stat() per poll; 1 =
+    # every step — detection latency is bounded by one step either way
+    # because SIGUSR1 forces an immediate poll)
+    poll_steps: int = 1
+    # runaway guard: a generation loop that resizes more than this many
+    # times in one run aborts loudly instead of thrashing forever
+    max_generations: int = 64
 
 
 @dataclass(frozen=True)
@@ -355,6 +399,7 @@ class Config:
     serve: ServeConfig = field(default_factory=ServeConfig)
     ft: FTConfig = field(default_factory=FTConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    elastic: ElasticConfig = field(default_factory=ElasticConfig)
 
     @property
     def num_classes(self) -> int:

@@ -82,6 +82,23 @@ class Speedometer:
 _END = object()
 
 
+def _accum_iter(batch_iter, grad_accum: int):
+    """Group a loader iterator into accumulation batches: every yield
+    stacks ``grad_accum`` consecutive loader batches into leaves shaped
+    ``(grad_accum, N, ...)``; a partial trailing group is dropped (the
+    epoch holds ``len(loader) // grad_accum`` optimizer steps)."""
+    from mx_rcnn_tpu.parallel.dp import stack_microbatches
+
+    while True:
+        group = []
+        for _ in range(grad_accum):
+            b = next(batch_iter, _END)
+            if b is _END:
+                return
+            group.append(b)
+        yield stack_microbatches(group)
+
+
 def _mean_metrics(window: List[Dict]) -> Dict[str, float]:
     """Host-side mean of a window of device metric dicts (one sync)."""
     if not window:
@@ -110,6 +127,8 @@ def fit(
     device_cache: bool = False,
     step_callback: Optional[Callable[[int], None]] = None,
     run_record=None,
+    grad_accum: int = 1,
+    multiproc: bool = False,
 ) -> TrainState:
     """Run ``begin_epoch .. num_epochs`` epochs; checkpoint per epoch.
 
@@ -159,6 +178,18 @@ def fit(
     skips its first ``skip`` batches; the deterministic per-epoch shuffle
     (``set_epoch``) plus the step-folded RNG make the continued run
     bit-identical to an uninterrupted one.
+    ``grad_accum``: microbatches accumulated per optimizer step (the
+    elastic shrink lever — ft/elastic.py): each step consumes
+    ``grad_accum`` consecutive loader batches, ``steps_per_epoch`` and
+    ``state.step`` count OPTIMIZER steps, so the LR schedule, the
+    step↔epoch mapping and the resume math are accumulation-invariant.
+    Not composable with ``device_cache`` (the HBM epoch cache gathers one
+    batch per step by construction).
+    ``multiproc``: the mesh spans multiple ``jax.distributed`` processes
+    (``parallel/multihost.py``): state replication and batch assembly go
+    through ``multihost_utils`` (every process feeds only its local image
+    slice of the deterministic global batch), and only process 0 writes
+    checkpoints (state is replicated, so host 0 holds the full values).
     """
     frequent = cfg.default.frequent if frequent is None else frequent
     # -- observability wiring (cfg.obs.enabled; docs/OBSERVABILITY.md) --
@@ -183,6 +214,12 @@ def fit(
                 "profile")
             prof = StepProfiler(pdir, cfg.obs.profile_at_step,
                                 cfg.obs.profile_steps)
+    grad_accum = max(int(grad_accum), 1)
+    if device_cache and (grad_accum > 1 or multiproc):
+        raise ValueError(
+            "device_cache composes with neither grad_accum nor multiproc "
+            "(the HBM epoch cache gathers exactly one batch per step, "
+            "single process) — use the streaming loader for elastic runs")
     cache = None
     if device_cache:
         import jax.numpy as jnp
@@ -232,30 +269,70 @@ def fit(
             return state, metrics
     elif mesh is not None and mesh.size > 1:
         from mx_rcnn_tpu.parallel.dp import (
-            make_dp_train_step, replicate, shard_batch)
+            make_dp_train_step, replicate, shard_accum_batch, shard_batch)
 
-        step_fn = make_dp_train_step(model, cfg, tx, mesh, mode=mode)
-        state = replicate(state, mesh)
+        step_fn = make_dp_train_step(model, cfg, tx, mesh, mode=mode,
+                                     grad_accum=grad_accum)
+        if multiproc:
+            # the mesh spans processes: device_put cannot address remote
+            # devices, so replication and batch assembly go through
+            # multihost_utils (parallel/multihost.py).  Every process
+            # iterates the same deterministic loader and contributes only
+            # its own image slice (rows [pid*per, (pid+1)*per) of the
+            # image axis) — identical math to single-process DP.
+            from mx_rcnn_tpu.parallel import multihost
 
-        def run_step(state, batch: Batch):
-            return step_fn(state, shard_batch(batch, mesh), key)
+            state = multihost.replicate_global(jax.device_get(state), mesh)
+
+            def run_step(state, batch: Batch):
+                gbatch = multihost.global_batch(
+                    multihost.local_image_slice(batch, accum=grad_accum > 1),
+                    mesh, accum=grad_accum > 1)
+                return step_fn(state, gbatch, key)
+        else:
+            state = replicate(state, mesh)
+            place = (shard_batch if grad_accum <= 1 else shard_accum_batch)
+
+            def run_step(state, batch: Batch):
+                return step_fn(state, place(batch, mesh), key)
     else:
-        base = jax.jit(make_train_step(model, cfg, tx, mode=mode),
+        from mx_rcnn_tpu.parallel.dp import own_leaves
+
+        base = jax.jit(make_train_step(model, cfg, tx, mode=mode,
+                                       grad_accum=grad_accum),
                        donate_argnums=(0,))
+        # a restored state arrives with numpy leaves (views of one
+        # msgpack buffer); the jitted step DONATES arg 0 — force
+        # private jax-owned copies first (parallel/dp.py — own_leaves)
+        state = own_leaves(state)
 
         def run_step(state, batch: Batch):
             return base(state, batch, key)
 
     n_dev = mesh.size if mesh is not None else 1
-    speedo = Speedometer(cfg.train.batch_images * n_dev, frequent,
-                         registry=rec)
-    steps_per_epoch = len(train_loader)
+    speedo = Speedometer(cfg.train.batch_images * n_dev * grad_accum,
+                         frequent, registry=rec)
+    # OPTIMIZER steps per epoch: with accumulation each step consumes
+    # grad_accum loader batches (a partial trailing group is dropped —
+    # the effective batch of every optimizer step stays on-recipe)
+    if grad_accum > 1 and len(train_loader) < grad_accum:
+        raise ValueError(
+            f"grad_accum={grad_accum} exceeds the loader's "
+            f"{len(train_loader)} batches/epoch — every epoch would run "
+            f"ZERO optimizer steps (and 'complete' without training); "
+            f"the dataset is too small for this topology")
+    steps_per_epoch = (len(train_loader) // grad_accum if grad_accum > 1
+                       else len(train_loader))
     done_steps = int(jax.device_get(state.step))
     snap = None
-    if prefix is not None:
+    if prefix is not None and not (multiproc and jax.process_index() != 0):
         from mx_rcnn_tpu.ft.snapshot import make_snapshotter
+        from mx_rcnn_tpu.utils.checkpoint import make_topology
 
-        snap = make_snapshotter(prefix, cfg, steps_per_epoch)
+        topo = make_topology(
+            n_dev, num_processes=jax.process_count() if multiproc else 1,
+            grad_accum=grad_accum, batch_images=cfg.train.batch_images)
+        snap = make_snapshotter(prefix, cfg, steps_per_epoch, topology=topo)
     try:
         for epoch in range(begin_epoch, num_epochs):
             if hasattr(train_loader, "set_epoch"):
@@ -283,13 +360,16 @@ def fit(
                 # skipped prefix
                 batch_iter = iter([None] * (steps_per_epoch - skip))
             else:
+                skip_b = skip * grad_accum  # loader batches, not opt steps
                 loader_skips = hasattr(train_loader, "skip_next_batches")
-                if skip and loader_skips:
-                    train_loader.skip_next_batches(skip)  # trims the order
+                if skip_b and loader_skips:
+                    train_loader.skip_next_batches(skip_b)  # trims the order
                 batch_iter = iter(train_loader)
-                if skip and not loader_skips:
-                    for _ in range(skip):  # fallback: decode-and-discard
+                if skip_b and not loader_skips:
+                    for _ in range(skip_b):  # fallback: decode-and-discard
                         next(batch_iter, None)
+                if grad_accum > 1:
+                    batch_iter = _accum_iter(batch_iter, grad_accum)
             if run_record is not None:
                 run_record.event("epoch_start", epoch=epoch, skip=skip,
                                  steps_per_epoch=steps_per_epoch)

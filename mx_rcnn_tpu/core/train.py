@@ -389,7 +389,8 @@ def setup_training(
 
 def make_train_step(model: FasterRCNN, cfg: Config,
                     tx: optax.GradientTransformation,
-                    axis_name: str | None = None, mode: str = "e2e"):
+                    axis_name: str | None = None, mode: str = "e2e",
+                    grad_accum: int = 1):
     """Build the jittable train step.  When ``axis_name`` is set the step is
     meant to run under shard_map/pmap-style SPMD and gradients/metrics are
     psum-averaged over that mesh axis (the TPU replacement for MXNet
@@ -398,6 +399,19 @@ def make_train_step(model: FasterRCNN, cfg: Config,
     ``mode`` selects the loss: 'e2e' (full Faster R-CNN), 'rpn' (alternate
     stages 1/3, expects :class:`Batch`), 'rcnn' (stages 2/4, expects
     :class:`RCNNBatch` with precomputed proposals).
+
+    ``grad_accum > 1`` builds the ACCUMULATING step the elastic controller
+    (ft/elastic.py) uses to keep the effective global batch on-recipe on a
+    shrunken mesh: the batch arrives with a leading microbatch axis
+    (leaves shaped ``(grad_accum, N, ...)``), gradients and metrics are
+    computed per microbatch under ``lax.map`` (serialized — peak
+    activation memory stays that of ONE microbatch) and averaged, then
+    ONE optimizer update applies.  ``state.step`` counts optimizer steps
+    in both paths, so the LR schedule and step↔epoch mapping are
+    accumulation-invariant by construction.  The per-microbatch RNG folds
+    in the microbatch index on top of the step fold, so microbatches
+    sample independently (``grad_accum=1`` keeps the exact pre-elastic
+    key derivation — resume streams stay bit-identical).
     """
     loss_and_metrics_fn = LOSS_FNS[mode]
 
@@ -406,12 +420,32 @@ def make_train_step(model: FasterRCNN, cfg: Config,
              ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
         key = jax.random.fold_in(key, state.step)
 
-        def loss_fn(params):
-            return loss_and_metrics_fn(model, params, state.batch_stats,
-                                       batch, key, cfg)
+        if grad_accum <= 1:
+            def loss_fn(params):
+                return loss_and_metrics_fn(model, params, state.batch_stats,
+                                           batch, key, cfg)
 
-        (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params)
+            (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params)
+        else:
+            def micro(idx_and_batch):
+                idx, mb = idx_and_batch
+                mkey = jax.random.fold_in(key, idx)
+
+                def loss_fn(params):
+                    return loss_and_metrics_fn(
+                        model, params, state.batch_stats, mb, mkey, cfg)
+
+                (_, m), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                    state.params)
+                return g, m
+
+            grads, metrics = jax.lax.map(
+                micro, (jnp.arange(grad_accum, dtype=jnp.int32), batch))
+            # mean over microbatches = the gradient of the mean loss over
+            # the full effective batch (each microbatch is equal-sized)
+            grads = jax.tree.map(lambda g: g.mean(axis=0), grads)
+            metrics = jax.tree.map(lambda m: m.mean(axis=0), metrics)
         if axis_name is not None:
             grads = jax.lax.pmean(grads, axis_name)
             metrics = jax.lax.pmean(metrics, axis_name)

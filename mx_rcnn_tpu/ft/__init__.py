@@ -15,12 +15,23 @@ Layers, bottom-up:
   itself;
 * ``supervisor.py`` — the crash-loop driver: kill ``tools/train.py`` M
   times, auto-resume, verify the survivor is BIT-IDENTICAL to an
-  uninterrupted control run.
+  uninterrupted control run; plus ``RestartPolicy`` (exponential backoff
+  + deterministic jitter + crash-loop verdict) and the multi-process
+  ``run_elastic_storm`` preemption-storm orchestrator;
+* ``elastic.py``  — the elastic run controller (ISSUE 6): topology
+  directives turn preemption into a live mesh shrink/grow — drain,
+  restore onto the new mesh (bit-identity audited), grad-accum rescale,
+  keep stepping (docs/FT.md "Elasticity").
 
-Entry point: ``python -m mx_rcnn_tpu.tools.crashloop`` (BENCH-style JSON
-record → ``docs/ft_crashloop.json``).
+Entry points: ``python -m mx_rcnn_tpu.tools.crashloop`` (BENCH-style
+JSON record → ``docs/ft_crashloop.json``), ``... tools.crashloop
+--elastic`` (storm record → ``ELASTIC_r06.json``), ``... tools.train
+--elastic`` (the production elastic run).
 """
 
+from mx_rcnn_tpu.ft.elastic import (ElasticController,  # noqa: F401
+                                    Topology, read_topology, respec,
+                                    run_elastic, write_topology)
 from mx_rcnn_tpu.ft.faults import Fault, FaultInjector, parse_plan  # noqa: F401
 from mx_rcnn_tpu.ft.integrity import (CheckpointRef,  # noqa: F401
                                       gc_checkpoints,
@@ -28,3 +39,5 @@ from mx_rcnn_tpu.ft.integrity import (CheckpointRef,  # noqa: F401
                                       retention_keep_set, verify_checkpoint)
 from mx_rcnn_tpu.ft.snapshot import (AsyncSnapshotter,  # noqa: F401
                                      SyncSnapshotter, make_snapshotter)
+from mx_rcnn_tpu.ft.supervisor import (RestartPolicy,  # noqa: F401
+                                       run_crashloop, run_elastic_storm)

@@ -1,0 +1,494 @@
+"""Elastic run controller: preemption becomes a mesh resize, not a crash.
+
+The reference stack's ``kvstore='device'`` sync training assumes a fixed
+device set for the whole run; on preemptible TPU fleets devices vanish
+and return mid-training.  PR 3 proved single-process kill/resume and the
+multichip dryrun proved an offline cross-mesh restore bit-matches one
+step — this module composes them into a run that KEEPS TRAINING through
+device loss (the TorchElastic / Varuna capability, expressed over
+``jax.distributed`` + the snapshot/integrity layer):
+
+* **Topology directives** — a scheduler (``ft/supervisor.py`` in the
+  drills; any fleet controller in production) atomically writes
+  ``{"generation": G, "num_devices": D, "num_processes": P, "ts": ...}``
+  to ``<prefix>.topology.json`` and optionally SIGUSR1s the process.
+  The controller polls the file every ``elastic.poll_steps`` optimizer
+  steps (SIGUSR1 forces an immediate poll), so detection latency is
+  bounded by one step.
+* **Drain** — a pending resize flips the fit loop's stop flag: the
+  in-flight step finishes, the async snapshotter flushes a step-exact
+  interrupt checkpoint (mesh topology + data cursor in its manifest),
+  and ``train_net`` returns.
+* **Restore onto the new mesh** — the live generalization of the PR 3
+  state-surgery path: the latest valid checkpoint restores onto a fresh
+  host template and is re-specced to the new mesh's ``NamedSharding``
+  (params, optimizer slots and batch stats alike — :func:`respec`), the
+  jitted step is rebuilt for the new mesh (one expected lowering burst
+  per generation, asserted against the recompile budget), and the
+  restore is verified BIT-IDENTICAL to the checkpoint it came from
+  (re-serialize → SHA-256 against the manifest).
+* **Grad-accum rescale** — the effective global batch and LR schedule
+  stay on-recipe: ``grad_accum = base_devices / current_devices``, so a
+  shrink to half the mesh runs twice the microbatches per optimizer
+  step and ``steps_per_epoch`` / ``state.step`` / the decay boundaries
+  never move (``core/train.py — make_train_step(grad_accum=...)``).
+* **Grow back** — a directive raising ``num_devices`` resizes the same
+  way in reverse; a directive changing ``num_processes`` cannot be
+  rewired live (``jax.distributed`` binds the process set at backend
+  init), so the controller drains and exits ``EXIT_RESIZE`` for the
+  supervisor to relaunch the world at the new size — the workers
+  restore onto the new mesh through the same verified path.
+
+Every transition (shrink, grow, restore, rescale, drain, peer failure)
+is emitted three ways: an ``ELASTIC_EVENT {json}`` stdout line (the
+supervisor's machine-readable timeline), a runrec event when a
+RunRecord is attached, and obs-registry gauges/counters
+(``elastic.generation``, ``elastic.num_devices``, ``elastic.grad_accum``,
+``elastic.shrinks`` / ``elastic.grows`` / ``elastic.restores``,
+``elastic.recovery_ms``) so a scheduler can watch health from one
+/metrics scrape.
+
+Entry: ``python -m mx_rcnn_tpu.tools.train --elastic`` (single process,
+live resize over local devices) or the same with ``--coordinator /
+--num_processes / --process_id`` (one worker of a ``jax.distributed``
+world, drain-and-relaunch resizes).  The storm drills live in
+``ft/supervisor.py — run_elastic_storm`` / ``tools/crashloop.py
+--elastic``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import signal
+import time
+from typing import Callable, NamedTuple, Optional
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+# distinctive exit codes the supervisor keys on: a worker that exits with
+# EXIT_RESIZE drained cleanly for a topology change it cannot apply live
+# (process-set resize); EXIT_PEER_FAILURE means a collective partner died
+# under it (jax.distributed peer loss) — recovery comes from the last
+# committed snapshot, not from this process
+EXIT_RESIZE = 77
+EXIT_PEER_FAILURE = 78
+
+
+class Topology(NamedTuple):
+    """One topology directive (or the currently-applied topology)."""
+
+    generation: int
+    num_devices: int
+    num_processes: int = 1
+    ts: float = 0.0  # when the scheduler issued it (detect timestamp)
+
+
+def topology_path(prefix: str, cfg=None) -> str:
+    """Where directives land for ``prefix`` (``elastic.topology_path``
+    overrides)."""
+    override = getattr(getattr(cfg, "elastic", None), "topology_path", "")
+    return override or f"{prefix}.topology.json"
+
+
+def write_topology(path: str, generation: int, num_devices: int,
+                   num_processes: int = 1, ts: Optional[float] = None) -> str:
+    """Atomically publish a topology directive (the scheduler side).
+    ``ts`` defaults to now — it is the detect timestamp recovery time is
+    measured from."""
+    from mx_rcnn_tpu.utils.checkpoint import _atomic_write
+
+    payload = {"generation": int(generation),
+               "num_devices": int(num_devices),
+               "num_processes": int(num_processes),
+               "ts": float(time.time() if ts is None else ts)}
+    return _atomic_write(path, json.dumps(payload, indent=1).encode())
+
+
+def read_topology(path: str) -> Optional[Topology]:
+    """Parse a directive file; None when absent or unparseable (a torn
+    directive is ignored until the scheduler's atomic rename lands)."""
+    try:
+        with open(path, "rb") as f:
+            raw = json.loads(f.read().decode())
+        return Topology(int(raw["generation"]), int(raw["num_devices"]),
+                        int(raw.get("num_processes", 1)),
+                        float(raw.get("ts", 0.0)))
+    except (FileNotFoundError, ValueError, KeyError, TypeError,
+            UnicodeDecodeError):
+        # TypeError: valid JSON that is not an object (e.g. `[4]`) —
+        # treated as torn/garbage like every other unparseable directive
+        return None
+
+
+def respec(tree, mesh, spec=None):
+    """Re-spec every leaf of a (host or addressable) pytree onto ``mesh``'s
+    ``NamedSharding`` — the state-surgery primitive: params, optimizer
+    slots and EMA/batch-stat leaves all move to the new mesh in one call.
+    ``spec`` defaults to fully-replicated (the DP layout); pass a spec
+    pytree for model-sharded state."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P() if spec is None else spec)
+    # jnp.array(copy=True): restored leaves are numpy views of a shared
+    # msgpack buffer, and the DP step DONATES this state — zero-copied
+    # externally-owned memory turns to garbage under donation
+    # (parallel/dp.py — own_leaves)
+    return jax.tree.map(
+        lambda x: jax.device_put(jnp.array(x, copy=True), sharding), tree)
+
+
+def infer_base_devices(cfg, prefix: str, directive: Topology) -> int:
+    """The RECIPE's reference device count.  ``elastic.base_devices``
+    when set; otherwise recovered from the newest checkpoint's recorded
+    topology (``global_batch / batch_images`` — authoritative no matter
+    which mesh wrote it).  The current directive is the LAST resort,
+    fresh runs only: a relaunched world that adopted a shrunken
+    directive as its base would silently halve the effective global
+    batch — exactly the drift the resume admission check exists to
+    catch (it hard-errors on a mis-derived base, by design)."""
+    if cfg.elastic.base_devices:
+        return cfg.elastic.base_devices
+    from mx_rcnn_tpu.ft.integrity import latest_valid_checkpoint
+
+    ref = latest_valid_checkpoint(prefix)
+    gb = ((ref.manifest.get("topology") or {}).get("global_batch")
+          if ref is not None else None)
+    if gb:
+        return max(int(gb) // cfg.train.batch_images, 1)
+    return directive.num_devices
+
+
+def _divide_base(base: int, devices: int, allow_remainder: bool) -> int:
+    """grad_accum for ``devices`` given the recipe's ``base`` device count.
+    Non-divisible topologies change the effective global batch — refused
+    unless the operator opted in (``ft.allow_resize_resume``)."""
+    if base % devices == 0:
+        return base // devices
+    if allow_remainder:
+        accum = max(base // devices, 1)
+        logger.warning(
+            "elastic: base_devices=%d not divisible by %d devices — "
+            "grad_accum=%d changes the effective global batch "
+            "(ft.allow_resize_resume permits it)", base, devices, accum)
+        return accum
+    raise ValueError(
+        f"elastic: base_devices={base} is not divisible by "
+        f"{devices} devices — the effective global batch cannot be "
+        f"preserved; choose a divisor topology or set "
+        f"ft.allow_resize_resume=true to accept the change")
+
+
+class ElasticController:
+    """Watches topology directives and drives the generation loop.
+
+    One controller per training process.  ``emit`` fan-outs every
+    transition to the ELASTIC_EVENT stdout timeline, the attached
+    RunRecord, and the process metrics registry.
+    """
+
+    def __init__(self, cfg, prefix: str, run_record=None,
+                 install_signal: bool = True):
+        self.cfg = cfg
+        self.prefix = prefix
+        self.path = topology_path(prefix, cfg)
+        self.run_record = run_record
+        self.poll_steps = max(int(cfg.elastic.poll_steps), 1)
+        self._poll_now = False
+        self._applied: Optional[Topology] = None
+        self._pending: Optional[Topology] = None
+        self._steps_since_poll = 0
+        from mx_rcnn_tpu.obs.metrics import registry
+
+        self._rec = registry()
+        if install_signal:
+            try:
+                signal.signal(signal.SIGUSR1, self._on_sigusr1)
+            except ValueError:  # not the main thread (embedded use)
+                logger.warning("elastic: not on the main thread — SIGUSR1 "
+                               "poll trigger disabled, file polling only")
+
+    # -- signals ------------------------------------------------------------
+    def _on_sigusr1(self, signum, frame):
+        # handler body deliberately trivial (flag flip only) — the
+        # SIGUSR2-profiler deadlock lesson from docs/OBSERVABILITY.md
+        self._poll_now = True
+
+    # -- directive plumbing -------------------------------------------------
+    def applied(self) -> Optional[Topology]:
+        return self._applied
+
+    def mark_applied(self, topo: Topology) -> None:
+        self._applied = topo
+        self._pending = None
+        self._rec.set_gauge("elastic.generation", topo.generation)
+        self._rec.set_gauge("elastic.num_devices", topo.num_devices)
+        self._rec.set_gauge("elastic.num_processes", topo.num_processes)
+
+    def pending(self) -> Optional[Topology]:
+        """The directive awaiting application, if any (cached from the
+        last poll)."""
+        return self._pending
+
+    def poll(self) -> Optional[Topology]:
+        """Read the directive file now; returns (and caches) a directive
+        newer than the applied topology, else None."""
+        directive = read_topology(self.path)
+        if directive is not None and (
+                self._applied is None
+                or directive.generation > self._applied.generation):
+            self._pending = directive
+        return self._pending
+
+    def resize_requested(self) -> bool:
+        """Per-step check (the fit stop-flag hook): polls the directive
+        file every ``poll_steps`` steps or immediately after SIGUSR1."""
+        if self._pending is not None:
+            return True
+        self._steps_since_poll += 1
+        if self._poll_now or self._steps_since_poll >= self.poll_steps:
+            self._poll_now = False
+            self._steps_since_poll = 0
+            if self.poll() is not None:
+                self.emit("resize_requested",
+                          generation=self._pending.generation,
+                          num_devices=self._pending.num_devices,
+                          num_processes=self._pending.num_processes,
+                          directive_ts=self._pending.ts)
+                return True
+        return False
+
+    def make_stop_flag(self, user_stop: Optional[Callable[[], bool]] = None
+                       ) -> Callable[[], bool]:
+        """The fit loop's stop flag: user stop (SIGTERM preemption) OR a
+        pending resize — both drain through the same interrupt-snapshot
+        path, by construction."""
+        def flag() -> bool:
+            if user_stop is not None and user_stop():
+                return True
+            return self.resize_requested()
+
+        return flag
+
+    # -- the three-way transition emitter -----------------------------------
+    def emit(self, event: str, **payload) -> None:
+        rec = {"ts": round(time.time(), 6), "event": event, **payload}
+        print("ELASTIC_EVENT " + json.dumps(rec), flush=True)
+        if self.run_record is not None:
+            self.run_record.event("elastic_" + event, **payload)
+        counter = {"shrink": "elastic.shrinks", "grow": "elastic.grows",
+                   "restore": "elastic.restores",
+                   "rescale": "elastic.rescales",
+                   "peer_failure": "elastic.peer_failures",
+                   "drain": "elastic.drains"}.get(event)
+        if counter:
+            self._rec.inc(counter)
+        if event == "first_step" and "recovery_ms" in payload:
+            self._rec.observe("elastic.recovery_ms",
+                              float(payload["recovery_ms"]),
+                              lo=1.0, hi=600_000.0)
+
+
+def parse_events(text: str):
+    """Extract ELASTIC_EVENT records from a worker's stdout (the
+    supervisor's timeline source — works without obs enabled)."""
+    events = []
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("ELASTIC_EVENT "):
+            try:
+                events.append(json.loads(line[len("ELASTIC_EVENT "):]))
+            except ValueError:
+                pass  # torn line (killed mid-write)
+    return events
+
+
+def _verify_restore(ref, state, steps_per_epoch: Optional[int]):
+    """The acceptance property, checked at every restore: re-serializing
+    the restored state must reproduce the checkpoint bytes it came from
+    (SHA-256 against the manifest) — restore onto a different mesh is
+    LOSSLESS or it is an error.  Returns (bit_identical, sha)."""
+    import hashlib
+
+    import jax
+
+    from mx_rcnn_tpu.utils.checkpoint import (serialize_interrupt,
+                                              serialize_state)
+
+    host = jax.device_get(state)
+    if ref.kind == "interrupt":
+        data = serialize_interrupt(host, steps_per_epoch)
+    else:
+        data = serialize_state(host)
+    sha = hashlib.sha256(data).hexdigest()
+    recorded = next(iter((ref.manifest.get("files") or {}).values()), {})
+    return sha == recorded.get("sha256"), sha
+
+
+def run_elastic(cfg, *, prefix: str, end_epoch: Optional[int] = None,
+                lr: Optional[float] = None, lr_step: Optional[str] = None,
+                frequent: Optional[int] = None, seed: int = 0,
+                dataset_kw: Optional[dict] = None,
+                pretrained: Optional[str] = None, pretrained_epoch: int = 0,
+                stop_flag: Optional[Callable[[], bool]] = None,
+                run_record=None, multiproc: bool = False,
+                fault_plan: Optional[str] = None) -> int:
+    """The generation loop: train under the current topology until done,
+    drained, or resized; apply live resizes in-process; exit with
+    ``EXIT_RESIZE`` for process-set changes the supervisor must relaunch.
+
+    Returns a process exit code (0 = training complete or drained on
+    SIGTERM; ``EXIT_RESIZE`` / ``EXIT_PEER_FAILURE`` as above).
+    ``multiproc``: this process is one worker of an initialized
+    ``jax.distributed`` world — every resize is a world resize.
+    """
+    import jax
+
+    from mx_rcnn_tpu.ft.integrity import latest_valid_checkpoint
+    from mx_rcnn_tpu.obs.metrics import LoweringCounter
+    from mx_rcnn_tpu.tools.train import train_net
+
+    ctrl = ElasticController(cfg, prefix, run_record=run_record)
+    end_epoch = cfg.default.e2e_epoch if end_epoch is None else end_epoch
+    available = jax.device_count()
+    nproc = jax.process_count() if multiproc else 1
+
+    directive = read_topology(ctrl.path)
+    if directive is None:
+        directive = Topology(0, available, nproc)
+    base = infer_base_devices(cfg, prefix, directive)
+    allow = cfg.ft.allow_resize_resume
+    generations = 0
+    last_accum: Optional[int] = None
+
+    while True:
+        generations += 1
+        if generations > cfg.elastic.max_generations:
+            raise RuntimeError(
+                f"elastic: more than {cfg.elastic.max_generations} "
+                f"generations in one run — topology thrash; raise "
+                f"elastic.max_generations if this is intended")
+        # directive.num_devices is GLOBAL (across every process)
+        devices = min(directive.num_devices, available)
+        if devices < directive.num_devices:
+            ctrl.emit("clamped", requested=directive.num_devices,
+                      available=available)
+        accum = _divide_base(base, devices, allow)
+        prev = ctrl.applied()
+        ctrl.mark_applied(directive._replace(num_devices=devices))
+        ctrl._rec.set_gauge("elastic.grad_accum", accum)
+        if prev is not None:
+            kind = "shrink" if devices < prev.num_devices else "grow"
+            ctrl.emit(kind, generation=directive.generation,
+                      num_devices=devices,
+                      num_processes=directive.num_processes,
+                      from_devices=prev.num_devices,
+                      from_processes=prev.num_processes)
+            if accum != last_accum:
+                ctrl.emit("rescale", grad_accum=accum,
+                          global_batch=devices * cfg.train.batch_images
+                          * accum)
+        last_accum = accum
+        ctrl.emit("mesh", generation=directive.generation,
+                  num_devices=devices, num_processes=nproc,
+                  grad_accum=accum, base_devices=base)
+
+        # restore verification + first-step recovery timing hooks; the
+        # lowering counter opens BEFORE the first step so every
+        # generation can prove "all (re)compiles happened at mesh
+        # rebuild, zero after the first step" — the recompile budget
+        resumable = latest_valid_checkpoint(prefix)
+        detect_ts = directive.ts or None
+        first_step_seen = [False]
+        gen = directive.generation
+        lc = LoweringCounter()
+        lc.__enter__()
+
+        def on_first_step(step, _gen=gen, _seen=first_step_seen,
+                          _detect=detect_ts, _lc=lc):
+            if not _seen[0]:
+                _seen[0] = True
+                now = time.time()
+                ctrl.emit("first_step", generation=_gen, step=step,
+                          lowerings=_lc.n,
+                          **({"recovery_ms":
+                              round((now - _detect) * 1e3, 1)}
+                             if _detect else {}))
+
+        def on_state_ready(state, ref, spe, _gen=gen):
+            if ref is None:
+                return
+            ok, sha = _verify_restore(ref, state, spe)
+            ctrl.emit("restore", generation=_gen, kind=ref.kind,
+                      path=ref.path, step=ref.step,
+                      bit_identical=bool(ok), sha256=sha)
+            if not ok:
+                raise RuntimeError(
+                    f"elastic restore is NOT bit-identical to "
+                    f"{ref.path} (re-serialized sha {sha} != manifest) "
+                    f"— cross-mesh state surgery is lossy; refusing to "
+                    f"continue training on corrupted state")
+
+        # NO admission override here: the grad-accum rescale keeps the
+        # effective global batch on-recipe, so train_net's topology
+        # check passes on its own — and if the base was mis-derived it
+        # HARD-ERRORS exactly as designed.  A genuinely batch-changing
+        # resize requires the operator's explicit ft.allow_resize_resume
+        # (the same flag _divide_base demands for non-divisible
+        # topologies).
+        try:
+            state = train_net(
+                cfg, prefix=prefix, end_epoch=end_epoch, lr=lr,
+                lr_step=lr_step, num_devices=devices,
+                frequent=frequent, seed=seed, dataset_kw=dataset_kw,
+                pretrained=pretrained, pretrained_epoch=pretrained_epoch,
+                resume="auto" if resumable is not None else False,
+                stop_flag=ctrl.make_stop_flag(stop_flag),
+                step_callback=on_first_step, run_record=run_record,
+                grad_accum=accum, multiproc=multiproc,
+                fault_plan=fault_plan,
+                post_restore_callback=on_state_ready)
+        except Exception as e:  # noqa: BLE001 — classified below
+            lc.__exit__(None, None, None)
+            if multiproc:
+                # a collective partner died under us (or the distributed
+                # runtime failed) — this process cannot make progress;
+                # recovery comes from the last committed snapshot on the
+                # relaunched world.  The supervisor's identical-failure
+                # give-up catches a genuine bug masquerading as peer loss.
+                ctrl.emit("peer_failure", generation=gen,
+                          error=repr(e)[:500])
+                logger.error("elastic: peer/collective failure: %s", e)
+                return EXIT_PEER_FAILURE
+            raise
+        lc.__exit__(None, None, None)
+        final_step = int(jax.device_get(state.step))
+        ctrl.emit("generation_end", generation=gen, lowerings=lc.n,
+                  step=final_step)
+        fault_plan = None  # a plan fires once, in its first generation
+
+        # fit returns for exactly three reasons: the run completed its
+        # epochs, the user stop (SIGTERM preemption) fired, or a resize
+        # drained it — classify in that priority order
+        if stop_flag is not None and stop_flag():
+            ctrl.emit("drain", generation=gen, reason="sigterm",
+                      step=final_step)
+            return 0
+        pending = ctrl.pending()
+        if pending is None:
+            # re-poll once: a directive may have landed on the last step
+            pending = ctrl.poll()
+        if pending is None:
+            ctrl.emit("complete", generation=gen, step=final_step)
+            return 0
+        if multiproc or pending.num_processes != nproc:
+            # process-set resize: drain and hand the relaunch to the
+            # supervisor (jax.distributed binds the process set at
+            # backend init — no live rewire)
+            ctrl.emit("drain", generation=pending.generation,
+                      reason="process_resize",
+                      num_processes=pending.num_processes)
+            return EXIT_RESIZE
+        directive = pending  # live in-process resize: loop

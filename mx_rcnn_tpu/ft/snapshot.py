@@ -69,7 +69,8 @@ class _Job:
 
     def __init__(self, kind: str, path: str, host_state, epoch: Optional[int],
                  steps_per_epoch: Optional[int], config_fp: Optional[str],
-                 clear_interrupt_after: bool, gc_fn=None, rec=None):
+                 clear_interrupt_after: bool, gc_fn=None, rec=None,
+                 topology=None):
         self.kind = kind
         self.path = path
         self.host_state = host_state
@@ -79,6 +80,7 @@ class _Job:
         self.clear_interrupt_after = clear_interrupt_after
         self.gc_fn = gc_fn
         self.rec = rec
+        self.topology = topology
 
 
 def _write_job(job: _Job, prefix: str) -> str:
@@ -96,7 +98,7 @@ def _write_job(job: _Job, prefix: str) -> str:
         step = int(job.host_state.step)
     commit_checkpoint(job.path, data, kind=job.kind, step=step,
                       epoch=job.epoch, steps_per_epoch=job.steps_per_epoch,
-                      config_fp=job.config_fp)
+                      config_fp=job.config_fp, topology=job.topology)
     if rec is not None:
         rec.inc("snapshot.commits")
         rec.inc("snapshot.bytes", len(data))
@@ -118,15 +120,20 @@ class _SnapshotterBase:
 
     ``cfg`` supplies the config fingerprint recorded in every manifest and
     the retention-GC policy; ``steps_per_epoch`` is recorded in interrupt
-    manifests (step-exact resume validity check).
+    manifests (step-exact resume validity check); ``topology``
+    (``utils/checkpoint.py — make_topology``) records the mesh shape +
+    effective global batch so restore-onto-a-different-mesh is principled
+    (docs/FT.md "Elasticity").
     """
 
     def __init__(self, prefix: str, cfg=None,
-                 steps_per_epoch: Optional[int] = None):
+                 steps_per_epoch: Optional[int] = None, topology=None):
         self.prefix = prefix
         self.cfg = cfg
         self.steps_per_epoch = steps_per_epoch
+        self.topology = topology
         self.config_fp = config_fingerprint(cfg) if cfg is not None else None
+        self._last_step: Optional[int] = None
         # observability (docs/OBSERVABILITY.md): with cfg.obs.enabled the
         # snapshotter records training-thread stall, serialized bytes and
         # commit latency into the process registry (None = off)
@@ -154,17 +161,41 @@ class _SnapshotterBase:
         return lambda: gc_checkpoints(prefix, keep_last=cfg.ft.keep_last,
                                       keep_every=cfg.ft.keep_every)
 
+    def _check_step(self, host_state) -> None:
+        """Corruption tripwire, checked BEFORE anything commits: within
+        one snapshotter's life the training step only moves forward, so
+        a negative or backwards step means the state is garbage (the
+        donated-aliased-buffer class the elastic storm caught — float
+        data over the int32 step; ``parallel/dp.py — own_leaves``).
+        Committing it would poison the restore chain silently; failing
+        the run here loses bounded work instead."""
+        step = int(np.asarray(host_state.step))
+        if step < 0 or (self._last_step is not None
+                        and step < self._last_step):
+            raise SnapshotError(
+                f"refusing to commit a snapshot at step {step} (last "
+                f"committed {self._last_step}): the training step went "
+                f"backwards — the in-memory state is corrupt (donated "
+                f"buffer aliasing?); restart from the last valid "
+                f"checkpoint")
+        self._last_step = step
+
     def _epoch_job(self, epoch: int, state) -> _Job:
+        host = fetch_owned(state)
+        self._check_step(host)
         return _Job("epoch", checkpoint_path(self.prefix, epoch),
-                    fetch_owned(state), epoch, self.steps_per_epoch,
+                    host, epoch, self.steps_per_epoch,
                     self.config_fp, clear_interrupt_after=True,
-                    gc_fn=self._gc_fn(), rec=self._rec)
+                    gc_fn=self._gc_fn(), rec=self._rec,
+                    topology=self.topology)
 
     def _interrupt_job(self, state) -> _Job:
+        host = fetch_owned(state)
+        self._check_step(host)
         return _Job("interrupt", interrupt_path(self.prefix),
-                    fetch_owned(state), None, self.steps_per_epoch,
+                    host, None, self.steps_per_epoch,
                     self.config_fp, clear_interrupt_after=False,
-                    rec=self._rec)
+                    rec=self._rec, topology=self.topology)
 
 
 class AsyncSnapshotter(_SnapshotterBase):
@@ -172,8 +203,8 @@ class AsyncSnapshotter(_SnapshotterBase):
 
     def __init__(self, prefix: str, cfg=None,
                  steps_per_epoch: Optional[int] = None,
-                 slot_timeout_s: Optional[float] = None):
-        super().__init__(prefix, cfg, steps_per_epoch)
+                 slot_timeout_s: Optional[float] = None, topology=None):
+        super().__init__(prefix, cfg, steps_per_epoch, topology=topology)
         self.slot_timeout_s = float(
             slot_timeout_s if slot_timeout_s is not None
             else (cfg.ft.slot_timeout_s if cfg is not None else 120.0))
@@ -288,9 +319,11 @@ class SyncSnapshotter(_SnapshotterBase):
         pass
 
 
-def make_snapshotter(prefix: str, cfg, steps_per_epoch: Optional[int] = None):
+def make_snapshotter(prefix: str, cfg, steps_per_epoch: Optional[int] = None,
+                     topology=None):
     """The ``core/fit.py`` factory: async unless ``ft.async_snapshots`` is
     off."""
     if cfg is not None and cfg.ft.async_snapshots:
-        return AsyncSnapshotter(prefix, cfg, steps_per_epoch)
-    return SyncSnapshotter(prefix, cfg, steps_per_epoch)
+        return AsyncSnapshotter(prefix, cfg, steps_per_epoch,
+                                topology=topology)
+    return SyncSnapshotter(prefix, cfg, steps_per_epoch, topology=topology)

@@ -27,18 +27,102 @@ emits the BENCH-style record (``docs/ft_crashloop.json``).
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 logger = logging.getLogger("mx_rcnn_tpu")
+
+
+class RestartPolicy:
+    """Restart pacing + crash-loop verdict for supervised training.
+
+    Replaces the fixed (zero) restart delay: consecutive NO-PROGRESS
+    failures back off exponentially (``base_s * factor^(n-1)``, capped)
+    with DETERMINISTIC jitter (hash of (seed, attempt) — reproducible
+    schedules, yet a fleet of supervisors won't thundering-herd a shared
+    filesystem), and ``give_up_after`` consecutive IDENTICAL failures
+    (same exit signature, same resume step) return a crash-loop verdict —
+    the transient-vs-deterministic distinction a scheduler needs: a
+    preemption storm makes progress between kills and never trips this; a
+    run that dies the same way at the same step every time is a bug, and
+    restarting it forever just burns fleet capacity.
+
+    Exported as registry gauges (``ft.supervisor.backoff_s``,
+    ``ft.supervisor.consecutive_failures``, ``ft.supervisor.crash_loop``)
+    so the verdict is scheduler-visible.  Schedule pinned by
+    ``tests/test_ft.py — test_restart_policy_backoff_schedule``.
+    """
+
+    def __init__(self, base_s: float = 0.25, factor: float = 2.0,
+                 cap_s: float = 30.0, jitter_frac: float = 0.25,
+                 give_up_after: int = 4, seed: int = 0, registry=None):
+        self.base_s = base_s
+        self.factor = factor
+        self.cap_s = cap_s
+        self.jitter_frac = jitter_frac
+        self.give_up_after = give_up_after
+        self.seed = seed
+        self.failures = 0          # consecutive no-progress failures
+        self.identical = 0         # consecutive IDENTICAL failures
+        self._last_sig: Optional[tuple] = None
+        if registry is None:
+            from mx_rcnn_tpu.obs.metrics import registry as _registry
+
+            registry = _registry()
+        self._rec = registry
+
+    def delay_s(self, n_failures: Optional[int] = None) -> float:
+        """The backoff before restart attempt ``n_failures`` (1-based);
+        0.0 while the run is making progress."""
+        n = self.failures if n_failures is None else n_failures
+        if n <= 0:
+            return 0.0
+        d = min(self.base_s * self.factor ** (n - 1), self.cap_s)
+        # deterministic jitter in [-jitter_frac, +jitter_frac]: same
+        # (seed, n) -> same delay, different supervisors -> spread
+        h = int(hashlib.sha256(f"{self.seed}:{n}".encode()).hexdigest(),
+                16) % 10_000
+        return d * (1.0 + self.jitter_frac * (h / 5_000.0 - 1.0))
+
+    def record(self, signature: tuple, made_progress: bool
+               ) -> Tuple[float, bool]:
+        """Record one attempt outcome; returns ``(delay_s, give_up)``.
+
+        ``signature`` identifies the failure mode (exit code + resume
+        step works well); ``made_progress`` resets the whole schedule —
+        a storm that advances between kills never backs off.
+        """
+        if made_progress:
+            self.failures = 0
+            self.identical = 0
+            self._last_sig = None
+        else:
+            self.failures += 1
+            self.identical = (self.identical + 1
+                              if signature == self._last_sig else 1)
+            self._last_sig = signature
+        give_up = self.identical >= self.give_up_after
+        delay = self.delay_s()
+        self._rec.set_gauge("ft.supervisor.backoff_s", delay)
+        self._rec.set_gauge("ft.supervisor.consecutive_failures",
+                            self.failures)
+        self._rec.set_gauge("ft.supervisor.crash_loop", int(give_up))
+        if give_up:
+            logger.error(
+                "crash-loop verdict: %d consecutive identical failures "
+                "(%r) — this is a deterministic bug, not a transient; "
+                "refusing to restart", self.identical, signature)
+        return delay, give_up
 
 # one kill event the scheduler will realize as a concrete fault plan once
 # it knows the resume point: (file_fault or None, signal name, placement)
@@ -164,6 +248,7 @@ def run_crashloop(workdir: str, *, events: Tuple[KillEvent, ...] = None,
     kills_survived = 0
     fallback_events = 0
     pending = list(events)
+    policy = RestartPolicy(seed=rng_seed)
     for attempt in range(max_attempts):
         cur, _ref = _progress(prefix)
         if cur >= total_steps:
@@ -219,6 +304,18 @@ def run_crashloop(workdir: str, *, events: Tuple[KillEvent, ...] = None,
             raise RuntimeError(
                 f"survivor attempt {attempt} died WITHOUT an injected kill "
                 f"(exit {proc.returncode}):\n{proc.stderr[-4000:]}")
+        # restart pacing + crash-loop verdict: progress resets the
+        # backoff, identical no-progress failures eventually give up
+        delay, give_up = policy.record((proc.returncode, cur), after > cur)
+        rec["backoff_s"] = round(delay, 3)
+        if give_up:
+            raise RuntimeError(
+                f"crash-loop verdict after {policy.identical} identical "
+                f"no-progress failures (exit {proc.returncode} at step "
+                f"{cur}); attempts={attempts}")
+        if delay:
+            logger.info("restart backoff: sleeping %.2fs", delay)
+            time.sleep(delay)
     else:
         raise RuntimeError(f"crashloop did not converge in {max_attempts} "
                            f"attempts; attempts={attempts}")
@@ -340,4 +437,404 @@ def measure_snapshot_overhead(steps: int = 96, snapshot_every: int = 32,
         "sync_stall_ms_per_snapshot": round(stall_s * 1e3, 2),
         "async_stall_overhead_pct": round(stall_a / epoch_s * 100, 2),
         "sync_stall_overhead_pct": round(stall_s / epoch_s * 100, 2),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Elastic storm orchestration (docs/FT.md "Elasticity"; ISSUE 6)
+# ---------------------------------------------------------------------------
+# The multi-process generalization of the crash loop above: instead of one
+# training process killed M times, a WORLD of N ``jax.distributed``
+# processes is driven through a preemption storm — staggered SIGTERM with
+# grace windows, SIGKILL without — and every casualty becomes a mesh
+# RESIZE instead of a dead run: the supervisor publishes a topology
+# directive (ft/elastic.py — write_topology) naming the surviving device
+# set, relaunches (or SIGUSR1-nudges) the world, and the elastic
+# controller restores the latest valid checkpoint onto the new mesh and
+# keeps stepping.  Recovery time is measured detect -> first step on the
+# new mesh, per transition; every restore must prove itself bit-identical
+# to the checkpoint it came from (the controller re-serializes and
+# SHA-256s against the manifest — a failed audit aborts the worker).
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class _Worker:
+    """One supervised training process with live stdout capture: lines
+    accumulate as they arrive (the world's ELASTIC_EVENT timeline must be
+    visible WHILE workers run — the supervisor synchronizes on it)."""
+
+    def __init__(self, proc: subprocess.Popen, idx: int, gen: int):
+        self.proc = proc
+        self.idx = idx
+        self.gen = gen
+        self.lines: List[str] = []
+        self.events: List[Dict] = []
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def _pump(self) -> None:
+        for line in self.proc.stdout:
+            line = line.rstrip("\n")
+            self.lines.append(line)
+            if line.startswith("ELASTIC_EVENT "):
+                try:
+                    ev = json.loads(line[len("ELASTIC_EVENT "):])
+                    ev["proc"] = self.idx
+                    self.events.append(ev)
+                except ValueError:
+                    pass  # torn line (process killed mid-write)
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def signal(self, sig: int) -> None:
+        if self.alive():
+            self.proc.send_signal(sig)
+
+    def join(self, timeout: float) -> Optional[int]:
+        """Wait for exit; returns the exit code or None on timeout."""
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            return None
+        self._thread.join(timeout=5.0)
+        return self.proc.returncode
+
+    def tail(self, n: int = 30) -> str:
+        return "\n".join(self.lines[-n:])
+
+
+def run_elastic_storm(workdir: str, *, smoke: bool = False,
+                      network: str = "tiny", dataset: str = "synthetic",
+                      end_epoch: Optional[int] = None, num_images: int = 24,
+                      image_size: Tuple[int, int] = (128, 160),
+                      seed: int = 0, base_devices: int = 2,
+                      grace_s: float = 60.0,
+                      world_timeout_s: float = 600.0) -> Dict:
+    """Drive a multi-process elastic run through a preemption storm;
+    returns the BENCH-style record (``tools/crashloop.py --elastic``
+    wraps it as ``ELASTIC_r06.json`` / ``make elastic-smoke``).
+
+    Full drill: 4 planned kills (2 SIGTERM, 2 SIGKILL) + the collateral
+    peer-failure casualty, one world shrink (2 procs x 1 dev -> 1 proc x
+    1 dev, grad_accum 2), one LIVE in-process device grow (1 -> 2
+    devices, no relaunch), one SIGKILL on the grown mesh, and one world
+    grow-back (1 proc -> 2 procs) that runs to completion.  ``smoke``:
+    one TERM preemption -> shrink -> grow-back -> completion (the
+    ``make elastic-smoke`` shape).
+    """
+    from mx_rcnn_tpu.ft.elastic import (EXIT_RESIZE, topology_path,
+                                        write_topology)
+
+    # epoch budget: every storm phase advances >= 1 epoch between
+    # preemptions (the full drill has six such phases), and the final
+    # grown world must still have epochs left to run to completion
+    end_epoch = end_epoch or (4 if smoke else 12)
+    spe = num_images // base_devices  # optimizer steps/epoch (no flip,
+    # batch_images=1, global batch preserved across every topology)
+    total_steps = end_epoch * spe
+    prefix = os.path.join(workdir, "storm", "e2e")
+    os.makedirs(os.path.dirname(prefix), exist_ok=True)
+    tpath = topology_path(prefix)
+    env = _child_env()
+    kw = dict(network=network, dataset=dataset, end_epoch=end_epoch,
+              seed=seed, num_images=num_images, image_size=image_size,
+              resume=False, fault_plan=None)
+
+    timeline: List[Dict] = []
+    recoveries: List[Dict] = []
+    kills = {"TERM": 0, "KILL": 0}
+    casualties = 0
+    worlds = 0
+    all_events: List[Dict] = []
+    policy = RestartPolicy(seed=seed)
+
+    def sup_event(event: str, **payload) -> Dict:
+        rec = {"ts": round(time.time(), 6), "event": event,
+               "by": "supervisor", **payload}
+        timeline.append(rec)
+        logger.info("storm: %s %s", event, payload)
+        return rec
+
+    def harvest(workers: List[_Worker]) -> None:
+        for w in workers:
+            for ev in w.events:
+                ev.setdefault("by", f"worker{w.idx}.g{w.gen}")
+            all_events.extend(w.events)
+
+    def launch_world(gen: int, devices: int, procs: int,
+                     local_devices: int) -> List[_Worker]:
+        nonlocal worlds
+        worlds += 1
+        cmd_base = _train_cmd(prefix, **kw)
+        cmd_base += ["--elastic",
+                     "--set", f"elastic__base_devices={base_devices}"]
+        workers = []
+        port = _free_port() if procs > 1 else None
+        for i in range(procs):
+            cmd = list(cmd_base)
+            wenv = dict(env)
+            # pin the virtual device count EXPLICITLY (an inherited
+            # XLA_FLAGS — e.g. the test conftest's 8-device rig — would
+            # otherwise override --local_devices and change the mesh)
+            wenv["XLA_FLAGS"] = ("--xla_force_host_platform_device_"
+                                 f"count={local_devices}")
+            if procs > 1:
+                cmd += ["--coordinator", f"localhost:{port}",
+                        "--num_processes", str(procs),
+                        "--process_id", str(i),
+                        "--local_devices", str(local_devices)]
+            workers.append(_Worker(subprocess.Popen(
+                cmd, env=wenv, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True), i, gen))
+        sup_event("world_launch", generation=gen, num_processes=procs,
+                  num_devices=devices, local_devices=local_devices)
+        return workers
+
+    def wait_event(workers: List[_Worker], name: str, gen: int,
+                   timeout: float) -> Dict:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for w in workers:
+                for ev in list(w.events):
+                    if ev["event"] == name and ev.get("generation") == gen:
+                        return ev
+            if all(not w.alive() for w in workers):
+                break
+            time.sleep(0.05)
+        tails = "\n---\n".join(w.tail() for w in workers)
+        raise RuntimeError(
+            f"storm: timed out ({timeout:.0f}s) waiting for worker event "
+            f"{name!r} gen {gen} (workers alive="
+            f"{[w.alive() for w in workers]}):\n{tails}")
+
+    def wait_progress(step: int, timeout: float = None) -> int:
+        deadline = time.monotonic() + (timeout or world_timeout_s)
+        while time.monotonic() < deadline:
+            cur, _ = _progress(prefix)
+            if cur >= step:
+                return cur
+            time.sleep(0.1)
+        raise RuntimeError(f"storm: no progress to step {step} "
+                           f"(at {_progress(prefix)[0]})")
+
+    def record_recovery(kind: str, detect_ts: float, ev: Dict) -> None:
+        recoveries.append({
+            "kind": kind, "detect_ts": round(detect_ts, 6),
+            "first_step_ts": ev["ts"], "generation": ev.get("generation"),
+            "recovery_ms": round((ev["ts"] - detect_ts) * 1e3, 1)})
+        sup_event("recovered", kind=kind, generation=ev.get("generation"),
+                  recovery_ms=recoveries[-1]["recovery_ms"])
+
+    def preempt(workers: List[_Worker], victim: int, sig_name: str
+                ) -> float:
+        """Inject one preemption and wind down the world; returns the
+        detect timestamp (the send — a real scheduler's watchdog would
+        observe the exit an instant later).
+
+        TERM gets its grace window: the victim finishes its in-flight
+        step (peers still participate in that collective) and drains.
+        Then the rest of the sync world — which CANNOT step on without
+        the victim — is asked to stop and, when wedged inside the dead
+        collective (a TERM handler only flips a flag the step loop never
+        reaches again), hard-killed: the scheduler-reality escalation.
+        Multi-process exit codes after a member dies are deliberately
+        not policed — the distributed shutdown barrier and coordination
+        service make peers abort in messy ways, and all of them are the
+        preemption's collateral."""
+        nonlocal casualties
+        kills[sig_name] += 1
+        detect = time.time()
+        sup_event("preempt", victim=victim, sig=sig_name)
+        workers[victim].signal(getattr(signal, "SIG" + sig_name))
+        if sig_name == "TERM":
+            deadline = time.monotonic() + grace_s
+            while time.monotonic() < deadline:
+                drained = not workers[victim].alive() or any(
+                    e["event"] in ("drain", "generation_end")
+                    for e in list(workers[victim].events))
+                if drained:
+                    break
+                time.sleep(0.05)
+        for w in workers:            # graceful ask for the stragglers
+            w.signal(signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        while (time.monotonic() < deadline
+               and any(w.alive() for w in workers)):
+            time.sleep(0.05)
+        for w in workers:
+            if w.alive():
+                w.proc.kill()
+                casualties += 1
+                sup_event("hard_casualty", proc=w.idx,
+                          reason="wedged in dead collective")
+        for w in workers:
+            w.join(30.0)
+        harvest(workers)
+        return detect
+
+    # ---- phase 1: the full world, then lose a process --------------------
+    gen = 0
+    write_topology(tpath, gen, base_devices, 2)
+    workers = launch_world(gen, base_devices, 2, 1)
+    wait_event(workers, "first_step", gen, world_timeout_s)
+    wait_progress(spe)          # >= 1 committed epoch before the storm
+    time.sleep(0.5)             # drift into the next epoch (mid-epoch)
+    # staggered: the victim gets its grace window and drains; the rest
+    # of the world follows through TERM->KILL escalation inside preempt()
+    detect = preempt(workers, victim=1, sig_name="TERM")
+    cur, _ = _progress(prefix)
+    policy.record(("TERM", cur), made_progress=cur > 0)
+
+    # ---- phase 2: shrink onto the survivor's devices ---------------------
+    gen = 1
+    sup_event("shrink", from_devices=base_devices, from_processes=2,
+              num_devices=base_devices // 2, num_processes=1)
+    write_topology(tpath, gen, base_devices // 2, 1, ts=detect)
+    workers = launch_world(gen, base_devices // 2, 1,
+                           local_devices=base_devices)
+    ev = wait_event(workers, "first_step", gen, world_timeout_s)
+    record_recovery("shrink_world", detect, ev)
+    start = _progress(prefix)[0]
+    wait_progress(start + spe)
+
+    if not smoke:
+        # ---- phase 3: SIGKILL, no grace — restart on the same mesh -------
+        time.sleep(0.3)
+        detect = preempt(workers, victim=0, sig_name="KILL")
+        cur2, _ = _progress(prefix)
+        delay, give_up = policy.record(("KILL", cur2),
+                                       made_progress=cur2 > cur)
+        assert not give_up, "storm made progress — give-up must not fire"
+        if delay:
+            time.sleep(delay)
+        write_topology(tpath, gen, base_devices // 2, 1, ts=detect)
+        workers = launch_world(gen, base_devices // 2, 1,
+                               local_devices=base_devices)
+        ev = wait_event(workers, "first_step", gen, world_timeout_s)
+        record_recovery("kill_restart", detect, ev)
+        wait_progress(_progress(prefix)[0] + spe)
+
+        # ---- phase 4: graceful TERM — step-exact interrupt resume --------
+        time.sleep(0.3)
+        detect = preempt(workers, victim=0, sig_name="TERM")
+        cur3, _ = _progress(prefix)
+        policy.record(("TERM", cur3), made_progress=True)
+        write_topology(tpath, gen, base_devices // 2, 1, ts=detect)
+        workers = launch_world(gen, base_devices // 2, 1,
+                               local_devices=base_devices)
+        ev = wait_event(workers, "first_step", gen, world_timeout_s)
+        record_recovery("term_restart", detect, ev)
+        wait_progress(_progress(prefix)[0] + spe)
+
+        # ---- phase 5: LIVE device grow (no relaunch) ---------------------
+        gen = 2
+        detect = time.time()
+        sup_event("grow", kind="live", num_devices=base_devices,
+                  num_processes=1)
+        write_topology(tpath, gen, base_devices, 1, ts=detect)
+        workers[0].signal(signal.SIGUSR1)
+        ev = wait_event(workers, "first_step", gen, world_timeout_s)
+        record_recovery("grow_live", detect, ev)
+        wait_progress(_progress(prefix)[0] + spe)
+
+        # ---- phase 6: SIGKILL the grown mesh, restart it -----------------
+        time.sleep(0.3)
+        detect = preempt(workers, victim=0, sig_name="KILL")
+        write_topology(tpath, gen, base_devices, 1, ts=detect)
+        workers = launch_world(gen, base_devices, 1,
+                               local_devices=base_devices)
+        ev = wait_event(workers, "first_step", gen, world_timeout_s)
+        record_recovery("kill_restart_grown", detect, ev)
+        wait_progress(_progress(prefix)[0] + spe)
+
+    # ---- final phase: grow the WORLD back and run to completion ----------
+    final_gen = 3 if not smoke else 2
+    detect = time.time()
+    sup_event("grow", kind="world", num_devices=base_devices,
+              num_processes=2)
+    write_topology(tpath, final_gen, base_devices, 2, ts=detect)
+    workers[0].signal(signal.SIGUSR1)
+    code = workers[0].join(grace_s)
+    if code is None:
+        raise RuntimeError("storm: worker did not drain for the world "
+                           "grow within the grace window:\n"
+                           + workers[0].tail(60))
+    if code != EXIT_RESIZE:
+        raise RuntimeError(f"storm: expected EXIT_RESIZE={EXIT_RESIZE} "
+                           f"drain, got exit {code}:\n{workers[0].tail(60)}")
+    harvest(workers)
+    sup_event("drain_observed", exit=code)
+    workers = launch_world(final_gen, base_devices, 2, 1)
+    ev = wait_event(workers, "first_step", final_gen, world_timeout_s)
+    record_recovery("grow_world", detect, ev)
+    exit_codes = [w.join(world_timeout_s) for w in workers]
+    harvest(workers)
+    if any(c != 0 for c in exit_codes):
+        tails = "\n---\n".join(w.tail(60) for w in workers)
+        raise RuntimeError(
+            f"storm: final world did not complete cleanly "
+            f"(exits {exit_codes}):\n{tails}")
+    final_step, final_ref = _progress(prefix)
+    sup_event("complete", step=final_step)
+
+    # ---- verdicts --------------------------------------------------------
+    restores = [e for e in all_events if e["event"] == "restore"]
+    first_steps = [e for e in all_events if e["event"] == "first_step"]
+    gen_ends = [e for e in all_events if e["event"] == "generation_end"]
+    # zero unexpected recompiles: every lowering of a generation happened
+    # at or before its first step (mesh-rebuild compiles are the budget;
+    # anything after step 1 is a leak)
+    unexpected = []
+    for ge in gen_ends:
+        match = [fs for fs in first_steps
+                 if fs.get("by") == ge.get("by")
+                 and fs.get("generation") == ge.get("generation")]
+        if match and ge.get("lowerings", 0) > match[-1].get("lowerings", 0):
+            unexpected.append({"by": ge.get("by"),
+                               "generation": ge.get("generation"),
+                               "extra": ge["lowerings"]
+                               - match[-1]["lowerings"]})
+    samples = sorted(r["recovery_ms"] for r in recoveries)
+
+    def pct(p):
+        if not samples:
+            return None
+        return samples[min(int(round(p / 100 * (len(samples) - 1))),
+                           len(samples) - 1)]
+
+    merged = sorted(timeline + all_events, key=lambda e: e["ts"])
+    return {
+        "metric": "elastic_storm",
+        "measured": True,
+        "smoke": smoke,
+        "network": network, "dataset": dataset,
+        "base_devices": base_devices,
+        "end_epoch": end_epoch, "steps_per_epoch": spe,
+        "total_steps": total_steps, "final_step": final_step,
+        "completed": final_step >= total_steps,
+        "worlds_launched": worlds,
+        "kills": kills,
+        "kills_total": kills["TERM"] + kills["KILL"],
+        "peer_casualties": casualties,
+        "shrinks": sum(1 for e in merged if e["event"] == "shrink"),
+        "grows": sum(1 for e in merged if e["event"] == "grow"),
+        "restores": len(restores),
+        "restores_bit_identical": all(e.get("bit_identical")
+                                      for e in restores),
+        "unexpected_recompiles": unexpected,
+        "recovery_ms": {
+            "samples": [r["recovery_ms"] for r in recoveries],
+            "by_kind": {r["kind"]: r["recovery_ms"] for r in recoveries},
+            "p50": pct(50), "p90": pct(90),
+            "max": samples[-1] if samples else None,
+        },
+        "timeline": merged,
     }

@@ -87,10 +87,29 @@ def data_axes(mesh: Mesh):
     return mesh.axis_names if len(mesh.axis_names) > 1 else mesh.axis_names[0]
 
 
+def own_leaves(tree):
+    """Force every numpy leaf into a PRIVATE jax-owned copy before it
+    feeds a DONATING step.  On the CPU backend, device placement of a
+    numpy array can be zero-copy — and a checkpoint restore
+    (``flax.serialization.msgpack_restore``) hands back numpy leaves
+    that are views of one shared buffer.  Donating such a buffer lets
+    XLA recycle memory the host side still owns: the elastic storm
+    caught epoch checkpoints committing float-garbage ``step`` values
+    in the first generation trained after a live resize (docs/FT.md
+    "Elasticity" — the third CPU aliasing bug in this family, after the
+    two ``ft/`` found in PR 3).  ``jnp.array(..., copy=True)`` contracts
+    a private copy; jax Arrays pass through untouched."""
+    return jax.tree.map(
+        lambda x: jnp.array(x, copy=True)
+        if isinstance(x, np.ndarray) else x, tree)
+
+
 def replicate(tree, mesh: Mesh):
-    """Place a pytree fully-replicated on the mesh."""
+    """Place a pytree fully-replicated on the mesh (numpy leaves forced
+    to private jax-owned copies first — see :func:`own_leaves`; the DP
+    step donates this state)."""
     sharding = NamedSharding(mesh, P())
-    return jax.device_put(tree, sharding)
+    return jax.device_put(own_leaves(tree), sharding)
 
 
 def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
@@ -99,13 +118,33 @@ def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 
-def _folded_step(model: FasterRCNN, cfg: Config, tx, axes, mode: str):
+def stack_microbatches(batches: Sequence[Batch]):
+    """Stack ``grad_accum`` consecutive loader batches into one
+    accumulation batch: leaves ``(N, ...) -> (grad_accum, N, ...)``.
+    Host-side numpy (the loader hands over host arrays); placement
+    happens in :func:`shard_accum_batch` / the jitted step."""
+    return jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                        *batches)
+
+
+def shard_accum_batch(batch: Batch, mesh: Mesh) -> Batch:
+    """Shard an accumulation batch (leading microbatch axis, images on
+    axis 1): microbatches replicated in sequence, images sharded —
+    ``P(None, data_axes)``, matching ``make_dp_train_step``'s
+    ``grad_accum > 1`` in_spec."""
+    sharding = NamedSharding(mesh, P(None, data_axes(mesh)))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def _folded_step(model: FasterRCNN, cfg: Config, tx, axes, mode: str,
+                 grad_accum: int = 1):
     """The per-shard step body shared by the streaming and cached DP paths:
     decorrelates per-image sampling RNG across mesh positions.  For a 2-D
     (dcn, ici) mesh ``axis_index`` over both axes is the linearized
     position, so an N-device run gives identical per-image keys regardless
     of the mesh factorization."""
-    base = make_train_step(model, cfg, tx, axis_name=axes, mode=mode)
+    base = make_train_step(model, cfg, tx, axis_name=axes, mode=mode,
+                           grad_accum=grad_accum)
 
     # graphlint: jit (runs under shard_map built by the two factories below)
     def shard_fn(state: TrainState, batch: Batch, key: jax.Array):
@@ -116,7 +155,7 @@ def _folded_step(model: FasterRCNN, cfg: Config, tx, axes, mode: str):
 
 
 def make_dp_train_step(model: FasterRCNN, cfg: Config, tx, mesh: Mesh,
-                       mode: str = "e2e"):
+                       mode: str = "e2e", grad_accum: int = 1):
     """Jitted SPMD train step over ``mesh``.
 
     Takes (replicated state, sharded batch, replicated key); returns
@@ -124,14 +163,22 @@ def make_dp_train_step(model: FasterRCNN, cfg: Config, tx, mesh: Mesh,
     ``lax.pmean`` over ALL of the mesh's axes (``'data'``, or
     ``('dcn', 'ici')`` for a hierarchical mesh) inside
     ``core.train.make_train_step``.
+
+    ``grad_accum > 1`` (the elastic shrink path): the batch carries a
+    leading microbatch axis — microbatches stay whole (``None``) and the
+    image axis (now axis 1) shards, so each device accumulates over ITS
+    slice of every microbatch and the pmean after accumulation yields the
+    effective-global-batch gradient in one collective per optimizer step.
     """
     axes = data_axes(mesh)
-    shard_fn = _folded_step(model, cfg, tx, axes, mode)
+    shard_fn = _folded_step(model, cfg, tx, axes, mode,
+                            grad_accum=grad_accum)
 
+    batch_spec = P(axes) if grad_accum <= 1 else P(None, axes)
     sharded = shard_map_compat(
         shard_fn,
         mesh=mesh,
-        in_specs=(P(), P(axes), P()),
+        in_specs=(P(), batch_spec, P()),
         out_specs=(P(), P()),
     )
     # donate the replicated state: in-place HBM update, no per-step copy

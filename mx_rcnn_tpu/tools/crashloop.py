@@ -11,10 +11,22 @@ uninterrupted control run.
 Usage:
   python -m mx_rcnn_tpu.tools.crashloop --out docs/ft_crashloop.json
   python -m mx_rcnn_tpu.tools.crashloop --smoke --check   # make ft-smoke
+  python -m mx_rcnn_tpu.tools.crashloop --elastic --out ELASTIC_r06.json
+  python -m mx_rcnn_tpu.tools.crashloop --elastic --smoke --check
+      # make elastic-smoke
 
 ``--smoke`` runs the 2-kill fast variant (one SIGTERM, one torn-write +
 SIGKILL); ``--check`` exits nonzero unless every invariant holds —
 the CI shape, mirroring ``tools/loadgen.py --smoke --check``.
+
+``--elastic`` runs the multi-process PREEMPTION STORM instead
+(``ft/supervisor.py — run_elastic_storm``; docs/FT.md "Elasticity"):
+a 2-process ``jax.distributed`` world loses members to staggered
+SIGTERM (grace window) and SIGKILL (none), shrinks onto the surviving
+devices with grad-accum rescale, grows back live and by world
+relaunch, and must finish the run — every restore proven bit-identical
+to its checkpoint, recovery time measured detect→first-step per
+transition, zero recompiles after any generation's first step.
 """
 
 from __future__ import annotations
@@ -27,9 +39,39 @@ import tempfile
 
 from mx_rcnn_tpu.ft.supervisor import (DEFAULT_EVENTS, SMOKE_EVENTS,
                                        measure_snapshot_overhead,
-                                       run_crashloop)
+                                       run_crashloop, run_elastic_storm)
 
 logger = logging.getLogger("mx_rcnn_tpu")
+
+
+def _check_elastic(rec: dict, smoke: bool) -> list:
+    """The elastic storm invariants (ISSUE 6 acceptance): mixed-signal
+    preemptions survived, >=1 shrink and >=1 grow, every restore
+    bit-identical, zero post-first-step recompiles, run completed."""
+    problems = []
+    want_kills = 1 if smoke else 4
+    if rec["kills_total"] < want_kills:
+        problems.append(f"only {rec['kills_total']} preemptions injected "
+                        f"(need >= {want_kills})")
+    if not smoke and (rec["kills"]["TERM"] < 1 or rec["kills"]["KILL"] < 1):
+        problems.append(f"preemptions not mixed: {rec['kills']}")
+    if rec["shrinks"] < 1:
+        problems.append("no mesh shrink in the timeline")
+    if rec["grows"] < 1:
+        problems.append("no grow-back in the timeline")
+    if rec["restores"] < 1:
+        problems.append("no restore events (the storm never exercised "
+                        "the state-surgery path)")
+    if not rec["restores_bit_identical"]:
+        problems.append("a restore was NOT bit-identical to its "
+                        "checkpoint")
+    if rec["unexpected_recompiles"]:
+        problems.append(f"recompiles after a generation's first step: "
+                        f"{rec['unexpected_recompiles']}")
+    if not rec["completed"]:
+        problems.append(f"run did not complete ({rec['final_step']} < "
+                        f"{rec['total_steps']} steps)")
+    return problems
 
 
 def main(argv=None) -> None:
@@ -38,7 +80,9 @@ def main(argv=None) -> None:
     p.add_argument("--dataset", default="synthetic")
     p.add_argument("--end_epoch", type=int, default=None,
                    help="default: 5 (smoke: 3)")
-    p.add_argument("--num_images", type=int, default=32)
+    p.add_argument("--num_images", type=int, default=None,
+                   help="synthetic dataset size (default: 32 for the "
+                        "crash loop, 24 for --elastic)")
     p.add_argument("--seed", type=int, default=0,
                    help="training seed (both arms)")
     p.add_argument("--rng_seed", type=int, default=0,
@@ -54,7 +98,41 @@ def main(argv=None) -> None:
                    help="skip the in-process snapshot-overhead measurement")
     p.add_argument("--max_overhead_pct", type=float, default=5.0,
                    help="--check: async snapshot overhead ceiling")
+    p.add_argument("--elastic", action="store_true",
+                   help="run the multi-process elastic preemption storm "
+                        "instead of the single-process crash loop")
     args = p.parse_args(argv)
+
+    if args.elastic:
+        auto_workdir = args.workdir is None
+        workdir = args.workdir or tempfile.mkdtemp(prefix="elastic_storm_")
+        logger.info("elastic storm workdir: %s", workdir)
+        rec = run_elastic_storm(
+            workdir, smoke=args.smoke, network=args.network,
+            dataset=args.dataset, end_epoch=args.end_epoch,
+            num_images=args.num_images or 24, seed=args.seed)
+        print(json.dumps(rec, indent=1))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(rec, f, indent=1)
+            logger.info("record written to %s", args.out)
+        if args.check:
+            problems = _check_elastic(rec, args.smoke)
+            for msg in problems:
+                logger.error("CHECK FAILED: %s", msg)
+            if problems:
+                logger.error("storm tree kept for triage: %s", workdir)
+                sys.exit(1)
+            logger.info(
+                "all elastic invariants hold (%d preemptions, %d shrinks, "
+                "%d grows, %d bit-identical restores, recovery p50 "
+                "%.0f ms)", rec["kills_total"], rec["shrinks"],
+                rec["grows"], rec["restores"], rec["recovery_ms"]["p50"])
+        if auto_workdir:
+            import shutil
+
+            shutil.rmtree(workdir, ignore_errors=True)
+        return
 
     events = SMOKE_EVENTS if args.smoke else DEFAULT_EVENTS
     end_epoch = args.end_epoch or (3 if args.smoke else 5)
@@ -64,7 +142,8 @@ def main(argv=None) -> None:
 
     rec = run_crashloop(
         workdir, events=events, network=args.network, dataset=args.dataset,
-        end_epoch=end_epoch, num_images=args.num_images, seed=args.seed,
+        end_epoch=end_epoch, num_images=args.num_images or 32,
+        seed=args.seed,
         rng_seed=args.rng_seed)
     rec = {"metric": "ft_crashloop", "measured": True,
            "network": args.network, "dataset": args.dataset,

@@ -60,6 +60,41 @@ def _legacy_resume(state, prefix: str, steps_per_epoch: int):
     return state, 0
 
 
+def _check_topology(manifest: dict, cfg, num_devices: int, grad_accum: int,
+                    path: str) -> None:
+    """Restore-on-a-different-mesh admission check (docs/FT.md
+    "Elasticity").  The manifest's ``topology`` record (written since the
+    elastic era — ``utils/checkpoint.py — make_topology``) names the
+    effective global batch the checkpoint was trained with; a resume that
+    would SILENTLY change it changes the LR-schedule semantics and the
+    experiment, so the old fingerprint-style WARNING is a hard error here.
+    ``cfg.ft.allow_resize_resume`` downgrades it back to a warning — the
+    elastic controller sets that for its supervised resizes, where the
+    grad-accum rescale (or an explicit operator decision) makes the
+    change principled instead of accidental."""
+    topo = (manifest or {}).get("topology")
+    if not topo or not topo.get("global_batch"):
+        return  # pre-topology manifest: nothing to check against
+    now = num_devices * cfg.train.batch_images * grad_accum
+    then = int(topo["global_batch"])
+    if then == now:
+        return
+    msg = (f"checkpoint {path} was trained with effective global batch "
+           f"{then} ({topo.get('devices')} devices x batch_images x "
+           f"grad_accum {topo.get('grad_accum')}) but this run would "
+           f"train with {now} ({num_devices} devices x "
+           f"{cfg.train.batch_images} images x grad_accum {grad_accum}) "
+           f"— the LR schedule and step↔epoch mapping would silently "
+           f"change")
+    if cfg.ft.allow_resize_resume:
+        logger.warning("resume: %s (ft.allow_resize_resume is set — "
+                       "continuing anyway)", msg)
+        return
+    raise ValueError(
+        msg + "; rescale grad_accum to preserve the global batch, or set "
+        "ft.allow_resize_resume=true to accept the resize")
+
+
 def _check_spe(saved_spe, steps_per_epoch: int, prefix: str) -> None:
     """Interrupt checkpoints are step-exact only under the same
     batches-per-epoch; mismatch must fail loudly (shared by the legacy and
@@ -86,7 +121,8 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
               resume=False, stop_flag=None,
               device_cache: bool = False, fault_plan: str = None,
               run_record=None, step_callback=None,
-              epoch_end_callback=None):
+              epoch_end_callback=None, grad_accum: int = 1,
+              multiproc: bool = False, post_restore_callback=None):
     """Train; returns the final TrainState.
 
     ``mode``: 'e2e' | 'rpn' | 'rcnn' — the alternate-training stage drivers
@@ -109,6 +145,19 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
     against itself (crash-loop certification; never set in production).
     ``run_record``: an ``obs/runrec.py`` RunRecord the fit loop appends
     structured events to (docs/OBSERVABILITY.md; None = off).
+    ``grad_accum``: microbatches accumulated per optimizer step — the
+    elastic mesh-shrink lever (ft/elastic.py): ``num_devices x
+    batch_images x grad_accum`` images feed every optimizer step, and
+    ``steps_per_epoch`` / the LR schedule count optimizer steps, so a
+    shrunken mesh with a rescaled ``grad_accum`` trains the SAME recipe.
+    ``multiproc``: ``num_devices`` spans every ``jax.distributed``
+    process (call ``parallel.multihost.initialize`` first); the mesh is
+    the global ``(dcn, ici)`` mesh, each process feeds its local image
+    slice, and only process 0 writes checkpoints.
+    ``post_restore_callback(state, ref, steps_per_epoch)``: invoked after
+    a VERIFIED resume restored ``state`` from ``ref`` (a
+    ``ft/integrity.py — CheckpointRef``), before training starts — the
+    elastic controller's restore-bit-identity audit hook.
     ``step_callback`` / ``epoch_end_callback``: forwarded to
     ``core.fit.fit`` (instrumentation hooks — ``tools/obs_smoke.py`` uses
     them to time steps and count per-epoch lowerings); a ``fault_plan``'s
@@ -120,6 +169,7 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
         _, roidb = load_gt_roidb(cfg, training=True, **(dataset_kw or {}))
     logger.info("[%s] training on %d roidb images", mode, len(roidb))
 
+    grad_accum = max(int(grad_accum), 1)
     n_total = cfg.train.batch_images * num_devices
     decode_pool = decode_pool_from_config(cfg)
     # with a decode pool the cache lives IN the workers (loader.py —
@@ -138,9 +188,13 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
         loader = AnchorLoader(roidb, cfg, batch_images=n_total,
                               shuffle=cfg.train.shuffle, seed=seed,
                               cache=cache, decode_pool=decode_pool)
-    steps_per_epoch = max(len(loader), 1)
-    logger.info("%d batches/epoch (global batch %d)", steps_per_epoch,
-                n_total)
+    # OPTIMIZER steps per epoch (== loader batches unless accumulating);
+    # the LR schedule and the step↔epoch resume math count these
+    steps_per_epoch = max(len(loader) // grad_accum, 1)
+    logger.info("%d optimizer steps/epoch (global batch %d = %d devices x "
+                "%d images x accum %d)", steps_per_epoch,
+                n_total * grad_accum, num_devices, cfg.train.batch_images,
+                grad_accum)
 
     model = build_model(cfg)
     bh, bw = cfg.bucket.shapes[0]
@@ -199,6 +253,10 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
                     "fingerprint %s but this run is %s — the recipe "
                     "changed; the continued run is NOT the same experiment",
                     ref.path, fp_ckpt, fp_now)
+            # effective-global-batch admission: a silent change is a hard
+            # error (ft.allow_resize_resume downgrades — elastic path)
+            _check_topology(ref.manifest, cfg, num_devices, grad_accum,
+                            ref.path)
             if ref.kind == "interrupt":
                 state, saved_spe = restore_interrupt(state, prefix)
                 _check_spe(saved_spe, steps_per_epoch, prefix)
@@ -212,6 +270,8 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
                 state = restore_state(state, prefix, begin_epoch)
                 logger.info("resumed from verified %s (epoch %d, step %d)",
                             ref.path, ref.epoch, ref.step)
+            if post_restore_callback is not None:
+                post_restore_callback(state, ref, steps_per_epoch)
     elif resume and begin_epoch == 0:
         state, begin_epoch = _legacy_resume(state, prefix, steps_per_epoch)
     elif begin_epoch > 0:
@@ -219,7 +279,16 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
         logger.info("resumed from %s epoch %d", prefix, begin_epoch)
 
     mesh = None
-    if num_devices > 1:
+    if multiproc:
+        from mx_rcnn_tpu.parallel import multihost
+
+        mesh = multihost.global_mesh()
+        if mesh.size != num_devices:
+            raise ValueError(
+                f"multiproc mesh spans {mesh.size} global devices but "
+                f"num_devices={num_devices} was requested — pass the "
+                f"GLOBAL device count (jax.device_count())")
+    elif num_devices > 1:
         from mx_rcnn_tpu.parallel.dp import device_mesh
 
         mesh = device_mesh(num_devices, dcn_size=dcn_size)
@@ -248,7 +317,8 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
                     profile_dir=profile_dir, stop_flag=stop_flag,
                     device_cache=device_cache, step_callback=step_callback,
                     run_record=run_record,
-                    epoch_end_callback=epoch_end_callback)
+                    epoch_end_callback=epoch_end_callback,
+                    grad_accum=grad_accum, multiproc=multiproc)
     finally:
         if decode_pool is not None:
             decode_pool.close()
@@ -354,6 +424,28 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--profile_dir", default=None,
                    help="capture a jax.profiler trace of early steps here")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic training (ft/elastic.py, docs/FT.md "
+                        "'Elasticity'): watch topology directives at "
+                        "<prefix>.topology.json (+ SIGUSR1), drain and "
+                        "resize the mesh live on device loss/return, "
+                        "rescale grad accumulation to keep the global "
+                        "batch on-recipe")
+    p.add_argument("--grad_accum", type=int, default=1,
+                   help="microbatches accumulated per optimizer step "
+                        "(effective global batch = num_devices x "
+                        "batch_images x grad_accum); the elastic "
+                        "controller manages this itself")
+    p.add_argument("--coordinator", default=None,
+                   help="jax.distributed coordinator HOST:PORT — makes "
+                        "this process one worker of a multi-process "
+                        "world (requires --num_processes/--process_id)")
+    p.add_argument("--num_processes", type=int, default=1)
+    p.add_argument("--process_id", type=int, default=0)
+    p.add_argument("--local_devices", type=int, default=None,
+                   help="pin the per-process CPU device count (the "
+                        "multi-host-without-a-cluster rig; leave unset "
+                        "on real TPU hosts)")
     add_set_arg(p)
     p.add_argument("--device_cache", action="store_true",
                    help="stage the epoch in HBM and gather batches on "
@@ -367,6 +459,19 @@ def main(argv=None):
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
     args = parse_args(argv)
+    multiproc = args.coordinator is not None
+    if multiproc:
+        # distributed init must precede ANY backend initialization —
+        # before config_from_args touches nothing device-side, but keep
+        # the ordering airtight by initializing first thing
+        from mx_rcnn_tpu.parallel import multihost
+
+        multihost.initialize(args.coordinator, args.num_processes,
+                             args.process_id,
+                             local_devices=args.local_devices)
+        logger.info("jax.distributed: process %d/%d, %d local / %d global "
+                    "devices", jax.process_index(), jax.process_count(),
+                    jax.local_device_count(), jax.device_count())
     cfg = config_from_args(args)
     dataset_kw = None
     if args.dataset_kw:
@@ -397,17 +502,34 @@ def main(argv=None):
     from mx_rcnn_tpu.obs.runrec import cli_obs
 
     obs_sess = cli_obs(cfg, "train")
+    exit_code = 0
     try:
-        train_net(cfg, prefix=args.prefix, begin_epoch=args.begin_epoch,
-                  end_epoch=args.end_epoch, lr=args.lr, lr_step=args.lr_step,
-                  num_devices=args.num_devices, frequent=args.frequent,
-                  seed=args.seed, pretrained=args.pretrained,
-                  pretrained_epoch=args.pretrained_epoch,
-                  profile_dir=args.profile_dir, dcn_size=args.dcn_size,
-                  resume=args.resume, stop_flag=lambda: stop["flag"],
-                  device_cache=args.device_cache, fault_plan=args.fault_plan,
-                  dataset_kw=dataset_kw,
-                  run_record=obs_sess.record if obs_sess else None)
+        if args.elastic or cfg.elastic.enabled:
+            from mx_rcnn_tpu.ft.elastic import run_elastic
+
+            exit_code = run_elastic(
+                cfg, prefix=args.prefix, end_epoch=args.end_epoch,
+                lr=args.lr, lr_step=args.lr_step, frequent=args.frequent,
+                seed=args.seed, dataset_kw=dataset_kw,
+                pretrained=args.pretrained,
+                pretrained_epoch=args.pretrained_epoch,
+                stop_flag=lambda: stop["flag"],
+                run_record=obs_sess.record if obs_sess else None,
+                multiproc=multiproc, fault_plan=args.fault_plan)
+        else:
+            train_net(cfg, prefix=args.prefix, begin_epoch=args.begin_epoch,
+                      end_epoch=args.end_epoch, lr=args.lr,
+                      lr_step=args.lr_step,
+                      num_devices=args.num_devices, frequent=args.frequent,
+                      seed=args.seed, pretrained=args.pretrained,
+                      pretrained_epoch=args.pretrained_epoch,
+                      profile_dir=args.profile_dir, dcn_size=args.dcn_size,
+                      resume=args.resume, stop_flag=lambda: stop["flag"],
+                      device_cache=args.device_cache,
+                      fault_plan=args.fault_plan,
+                      dataset_kw=dataset_kw, grad_accum=args.grad_accum,
+                      multiproc=multiproc,
+                      run_record=obs_sess.record if obs_sess else None)
     finally:
         if obs_sess is not None:
             from mx_rcnn_tpu.obs.metrics import registry
@@ -416,6 +538,10 @@ def main(argv=None):
                            value=registry().gauge("train.samples_per_sec"),
                            unit="imgs/s",
                            steps=registry().counter("train.steps"))
+    if exit_code:
+        import sys
+
+        sys.exit(exit_code)
 
 
 if __name__ == "__main__":

@@ -91,13 +91,42 @@ def config_fingerprint(cfg) -> str:
     return hashlib.sha256(parts.encode()).hexdigest()[:16]
 
 
+def make_topology(num_devices: int, num_processes: int = 1,
+                  grad_accum: int = 1, batch_images: int = 1) -> Dict:
+    """The manifest ``topology`` record: mesh shape + effective global
+    batch of the run that WROTE a checkpoint.  ``global_batch`` is the
+    images consumed per OPTIMIZER step (devices x batch_images x
+    grad_accum; the process count is already folded into the device
+    count — ``jax.device_count()`` is global).  Restore onto a different
+    mesh is principled exactly when this number is preserved (the LR
+    schedule and step↔epoch mapping count optimizer steps); the resume
+    path hard-errors on a silent change (``tools/train.py``,
+    ``ft.allow_resize_resume`` overrides)."""
+    return {
+        "devices": int(num_devices),
+        "processes": int(num_processes),
+        "grad_accum": int(grad_accum),
+        "global_batch": int(num_devices) * int(batch_images)
+        * int(grad_accum),
+    }
+
+
 def write_manifest(path: str, data: bytes, *, kind: str, step: int,
                    epoch: Optional[int] = None,
                    steps_per_epoch: Optional[int] = None,
-                   config_fp: Optional[str] = None) -> str:
+                   config_fp: Optional[str] = None,
+                   topology: Optional[Dict] = None) -> str:
     """Write the commit-point manifest for ``path`` whose payload bytes are
     ``data`` (hashed here, not re-read, so the manifest can never describe
-    bytes other than the ones just written)."""
+    bytes other than the ones just written).
+
+    ``topology`` (see :func:`make_topology`) records the writing run's
+    mesh shape + effective global batch; with ``steps_per_epoch`` it also
+    yields the data-shard cursor — the deterministic per-epoch shuffle
+    means (epoch, optimizer steps into the epoch, grad_accum) IS the
+    cursor: ``consumed_batches = (step - epoch_start) * grad_accum``
+    loader batches of ``global_batch / grad_accum`` images each.  Older
+    manifests simply lack the keys (readers treat that as unknown)."""
     manifest = {
         "format": 1,
         "kind": kind,
@@ -110,6 +139,18 @@ def write_manifest(path: str, data: bytes, *, kind: str, step: int,
             "bytes": len(data),
         }},
     }
+    if topology is not None:
+        manifest["topology"] = topology
+        if steps_per_epoch:
+            in_epoch = int(step) % int(steps_per_epoch)
+            manifest["data_cursor"] = {
+                "epoch": int(step) // int(steps_per_epoch),
+                "steps_in_epoch": in_epoch,
+                "batches_consumed": in_epoch
+                * int(topology.get("grad_accum", 1)),
+                "images_consumed": int(step)
+                * int(topology.get("global_batch", 0)),
+            }
     return _atomic_write(manifest_path(path),
                          json.dumps(manifest, indent=1).encode())
 
@@ -154,17 +195,20 @@ def serialize_interrupt(host_state, steps_per_epoch: Optional[int]) -> bytes:
 def commit_checkpoint(path: str, data: bytes, *, kind: str, step: int,
                       epoch: Optional[int] = None,
                       steps_per_epoch: Optional[int] = None,
-                      config_fp: Optional[str] = None) -> str:
+                      config_fp: Optional[str] = None,
+                      topology: Optional[Dict] = None) -> str:
     """Durably write ``data`` then its manifest (the commit point)."""
     _atomic_write(path, data)
     write_manifest(path, data, kind=kind, step=step, epoch=epoch,
-                   steps_per_epoch=steps_per_epoch, config_fp=config_fp)
+                   steps_per_epoch=steps_per_epoch, config_fp=config_fp,
+                   topology=topology)
     return path
 
 
 def save_checkpoint(prefix: str, epoch: int, state, *,
                     steps_per_epoch: Optional[int] = None,
-                    config_fp: Optional[str] = None) -> str:
+                    config_fp: Optional[str] = None,
+                    topology: Optional[Dict] = None) -> str:
     """Serialize a full TrainState (params, batch_stats, opt_state, step).
 
     Ref ``do_checkpoint`` epoch_end_callback; returns the written path.
@@ -174,7 +218,8 @@ def save_checkpoint(prefix: str, epoch: int, state, *,
     return commit_checkpoint(
         checkpoint_path(prefix, epoch), serialize_state(host),
         kind="epoch", step=int(np.asarray(host.step)), epoch=epoch,
-        steps_per_epoch=steps_per_epoch, config_fp=config_fp)
+        steps_per_epoch=steps_per_epoch, config_fp=config_fp,
+        topology=topology)
 
 
 def load_checkpoint(prefix: str, epoch: int) -> Dict[str, Any]:
@@ -208,7 +253,8 @@ def interrupt_path(prefix: str) -> str:
 
 
 def save_interrupt(prefix: str, state, steps_per_epoch: int = None, *,
-                   config_fp: Optional[str] = None) -> str:
+                   config_fp: Optional[str] = None,
+                   topology: Optional[Dict] = None) -> str:
     """Atomically save a mid-epoch TrainState for preemption resume.
 
     ``steps_per_epoch`` is recorded alongside the state: mid-epoch resume
@@ -220,7 +266,8 @@ def save_interrupt(prefix: str, state, steps_per_epoch: int = None, *,
     return commit_checkpoint(
         interrupt_path(prefix), serialize_interrupt(host, steps_per_epoch),
         kind="interrupt", step=int(np.asarray(host.step)),
-        steps_per_epoch=steps_per_epoch, config_fp=config_fp)
+        steps_per_epoch=steps_per_epoch, config_fp=config_fp,
+        topology=topology)
 
 
 def restore_interrupt(template_state, prefix: str):

@@ -1,0 +1,256 @@
+"""Elastic-training unit tests (mx_rcnn_tpu/ft/elastic.py + the
+grad-accumulation step; docs/FT.md "Elasticity").
+
+Everything here runs in-process on the CPU tier-1 rig: topology
+directive plumbing, the controller's poll/pending state machine, the
+accumulating train step's EXACT semantics (average of per-microbatch
+gradients, one optimizer update), schedule invariance of the fit loop
+under accumulation, and interrupt-resume bit-exactness with
+``grad_accum > 1``.  The multi-process storm (real SIGKILLs, world
+relaunches, live SIGUSR1 resizes) is ``make elastic-smoke`` /
+``tools/crashloop.py --elastic``.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tests.test_train_step import KEY, make_batch, tiny_setup
+
+from mx_rcnn_tpu.core.fit import fit
+from mx_rcnn_tpu.core.train import make_train_step
+from mx_rcnn_tpu.ft.elastic import (ElasticController, Topology,
+                                    parse_events, read_topology, respec,
+                                    topology_path, write_topology)
+from mx_rcnn_tpu.parallel.dp import stack_microbatches
+
+
+class FakeLoader:
+    shuffle = False
+
+    def __init__(self, batches):
+        self.batches = list(batches)
+
+    def __len__(self):
+        return len(self.batches)
+
+    def __iter__(self):
+        return iter(self.batches)
+
+
+# ---- topology directives ---------------------------------------------------
+
+
+def test_topology_directive_roundtrip(tmp_path):
+    p = str(tmp_path / "m.topology.json")
+    write_topology(p, 3, 4, num_processes=2, ts=123.5)
+    topo = read_topology(p)
+    assert topo == Topology(3, 4, 2, 123.5)
+
+
+def test_topology_torn_file_reads_none(tmp_path):
+    p = str(tmp_path / "m.topology.json")
+    assert read_topology(p) is None  # absent
+    with open(p, "w") as f:
+        f.write('{"generation": 3, "num_dev')  # torn mid-write
+    assert read_topology(p) is None
+    with open(p, "w") as f:
+        f.write('{"num_devices": 4}')  # missing required key
+    assert read_topology(p) is None
+
+
+def test_topology_path_override(tmp_path):
+    from mx_rcnn_tpu.config import generate_config
+
+    cfg = generate_config("tiny", "PascalVOC")
+    assert topology_path("/runs/m/e2e", cfg) == "/runs/m/e2e.topology.json"
+    cfg = cfg.replace_in("elastic", topology_path="/etc/topo.json")
+    assert topology_path("/runs/m/e2e", cfg) == "/etc/topo.json"
+
+
+def test_parse_events_skips_torn_lines():
+    text = ("noise\n"
+            'ELASTIC_EVENT {"ts": 1.0, "event": "mesh", "num_devices": 4}\n'
+            'ELASTIC_EVENT {"ts": 2.0, "event": "first_st\n'   # killed
+            'ELASTIC_EVENT {"ts": 3.0, "event": "restore"}\n')
+    events = parse_events(text)
+    assert [e["event"] for e in events] == ["mesh", "restore"]
+
+
+# ---- controller state machine ----------------------------------------------
+
+
+def _controller(tmp_path):
+    from mx_rcnn_tpu.config import generate_config
+
+    cfg = generate_config("tiny", "PascalVOC")
+    prefix = str(tmp_path / "m" / "e2e")
+    os.makedirs(os.path.dirname(prefix), exist_ok=True)
+    return ElasticController(cfg, prefix, install_signal=False), prefix
+
+
+def test_controller_polls_and_caches_pending(tmp_path, capsys):
+    ctrl, prefix = _controller(tmp_path)
+    ctrl.mark_applied(Topology(0, 8, 1))
+    assert not ctrl.resize_requested()          # no directive file yet
+    write_topology(ctrl.path, 1, 4, 1)
+    assert ctrl.resize_requested()              # poll_steps=1: seen now
+    assert ctrl.pending() == read_topology(ctrl.path)
+    # the emitted transition is machine-readable on stdout
+    events = parse_events(capsys.readouterr().out)
+    assert events and events[-1]["event"] == "resize_requested"
+    assert events[-1]["num_devices"] == 4
+    # applying the directive clears pending
+    ctrl.mark_applied(ctrl.pending())
+    assert not ctrl.resize_requested()
+
+
+def test_controller_ignores_stale_generations(tmp_path):
+    ctrl, _ = _controller(tmp_path)
+    write_topology(ctrl.path, 2, 4, 1)
+    ctrl.mark_applied(Topology(2, 4, 1))
+    write_topology(ctrl.path, 1, 8, 1)          # older generation
+    assert not ctrl.resize_requested()
+    write_topology(ctrl.path, 3, 8, 1)          # newer: fires
+    assert ctrl.resize_requested()
+
+
+def test_controller_stop_flag_composes_user_stop(tmp_path):
+    ctrl, _ = _controller(tmp_path)
+    ctrl.mark_applied(Topology(0, 8, 1))
+    user = {"stop": False}
+    flag = ctrl.make_stop_flag(lambda: user["stop"])
+    assert not flag()
+    user["stop"] = True
+    assert flag()                                # SIGTERM path
+    user["stop"] = False
+    write_topology(ctrl.path, 1, 4, 1)
+    assert flag()                                # resize path
+
+
+def test_infer_base_devices_prefers_checkpoint_topology(tmp_path):
+    """A relaunched world must recover the RECIPE base from the
+    checkpoint's recorded topology, not from the (possibly shrunken)
+    current directive — otherwise a shrink would silently become the new
+    recipe and halve the effective global batch (code-review finding)."""
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.ft.elastic import infer_base_devices
+    from mx_rcnn_tpu.utils.checkpoint import make_topology, save_checkpoint
+
+    cfg = generate_config("tiny", "PascalVOC")
+    prefix = str(tmp_path / "m")
+    shrunk = Topology(3, 4, 1)  # directive AFTER a shrink from 8
+
+    # explicit config wins
+    cfg8 = cfg.replace_in("elastic", base_devices=8)
+    assert infer_base_devices(cfg8, prefix, shrunk) == 8
+    # no checkpoint yet (fresh run): the directive is all there is
+    assert infer_base_devices(cfg, prefix, shrunk) == 4
+    # checkpoint written by the ORIGINAL 8-device recipe: authoritative
+    _, _, _, state = tiny_setup()
+    save_checkpoint(prefix, 1, state, steps_per_epoch=10,
+                    topology=make_topology(8, grad_accum=1,
+                                           batch_images=1))
+    assert infer_base_devices(cfg, prefix, shrunk) == 8
+
+
+# ---- the accumulating train step -------------------------------------------
+
+
+def test_grad_accum_step_is_exact_average_of_microbatch_grads():
+    """``grad_accum=2`` must equal: per-microbatch gradients with the
+    documented key derivation (fold step, fold microbatch index),
+    averaged, then ONE tx.update — replicated here leaf by leaf."""
+    from mx_rcnn_tpu.core.train import loss_and_metrics
+
+    cfg, model, tx, state = tiny_setup()
+    b0, b1 = make_batch(seed=0), make_batch(seed=1)
+    acc = jax.tree.map(jnp.asarray, stack_microbatches([b0, b1]))
+
+    step = jax.jit(make_train_step(model, cfg, tx, grad_accum=2))
+    got, _ = step(state, acc, KEY)
+
+    base_key = jax.random.fold_in(KEY, state.step)
+    grads = []
+    for i, mb in enumerate((b0, b1)):
+        k = jax.random.fold_in(base_key, jnp.int32(i))
+        g = jax.grad(
+            lambda p: loss_and_metrics(model, p, state.batch_stats, mb,
+                                       k, cfg)[0])(state.params)
+        grads.append(g)
+    mean_g = jax.tree.map(lambda a, b: (jnp.stack([a, b]).mean(0)),
+                          *grads)
+    updates, _ = tx.update(mean_g, state.opt_state, state.params)
+    want_params = optax.apply_updates(state.params, updates)
+
+    assert int(got.step) == 1
+    for a, b in zip(jax.tree.leaves(got.params),
+                    jax.tree.leaves(want_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0, atol=1e-6)
+
+
+def test_grad_accum_fit_preserves_schedule_and_resume(tmp_path):
+    """Accumulation-invariance of the bookkeeping: 4 loader batches with
+    ``grad_accum=2`` is 2 OPTIMIZER steps/epoch (manifest agrees), and a
+    mid-epoch interrupt + resume reproduces the uninterrupted run
+    bit-exactly (the skip math consumes skip*accum loader batches)."""
+    from mx_rcnn_tpu.utils.checkpoint import (checkpoint_path,
+                                              read_manifest,
+                                              restore_interrupt)
+
+    batches = [make_batch(seed=s) for s in range(4)]
+
+    cfg, model, tx, s0 = tiny_setup()
+    ref = fit(model, cfg, s0, tx, FakeLoader(batches), 2, KEY,
+              frequent=1000, grad_accum=2)
+    assert int(ref.step) == 4                    # 2 epochs x 2 opt steps
+
+    prefix = str(tmp_path / "m" / "e2e")
+    _, _, _, s1 = tiny_setup()
+    fit(model, cfg, s1, tx, FakeLoader(batches), 2, KEY, prefix=prefix,
+        frequent=1000, grad_accum=2,
+        stop_flag=lambda: True)   # fires after step 1 of 2 — mid-epoch,
+    # so the drain writes an interrupt checkpoint, not an epoch one
+    _, _, _, template = tiny_setup()
+    resumed, spe = restore_interrupt(template, prefix)
+    assert spe == 2 and int(resumed.step) == 1
+    final = fit(model, cfg, resumed, tx, FakeLoader(batches), 2, KEY,
+                prefix=prefix, frequent=1000, grad_accum=2)
+    assert int(final.step) == 4
+    for a, b in zip(jax.tree.leaves(ref.params),
+                    jax.tree.leaves(final.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    m = read_manifest(checkpoint_path(prefix, 2))
+    assert m["steps_per_epoch"] == 2
+    assert m["topology"]["grad_accum"] == 2
+    assert m["topology"]["global_batch"] == 2
+
+
+def test_device_cache_refuses_grad_accum():
+    cfg, model, tx, state = tiny_setup()
+    with pytest.raises(ValueError, match="device_cache"):
+        fit(model, cfg, state, tx, FakeLoader([make_batch()]), 1, KEY,
+            grad_accum=2, device_cache=True)
+
+
+# ---- respec (the state-surgery primitive) ----------------------------------
+
+
+def test_respec_replicates_onto_target_mesh():
+    from mx_rcnn_tpu.parallel.dp import device_mesh
+
+    _, _, _, state = tiny_setup()
+    host = jax.device_get(state)
+    mesh4 = device_mesh(4)
+    moved = respec(host, mesh4)
+    leaf = jax.tree.leaves(moved.params)[0]
+    assert len(leaf.sharding.device_set) == 4
+    for a, b in zip(jax.tree.leaves(host), jax.tree.leaves(moved)):
+        np.testing.assert_array_equal(np.asarray(a),
+                                      np.asarray(jax.device_get(b)))

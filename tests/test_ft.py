@@ -409,6 +409,152 @@ def test_resume_auto_falls_back_to_legacy_for_premanifest_dirs(tmp_path,
     assert os.path.exists(checkpoint_path(prefix, 2))
 
 
+# ---- supervisor restart policy (elastic era) -------------------------------
+
+
+def test_restart_policy_backoff_schedule():
+    """Regression pin for the restart schedule: exponential growth to the
+    cap, deterministic jitter within ±jitter_frac, progress resets, and
+    the give-up verdict fires only on IDENTICAL consecutive failures."""
+    from mx_rcnn_tpu.ft.supervisor import RestartPolicy
+
+    a = RestartPolicy(base_s=0.25, factor=2.0, cap_s=30.0,
+                      jitter_frac=0.25, give_up_after=3, seed=7)
+    b = RestartPolicy(base_s=0.25, factor=2.0, cap_s=30.0,
+                      jitter_frac=0.25, give_up_after=3, seed=7)
+    raw = [0.25 * 2.0 ** (n - 1) for n in range(1, 12)]
+    for n, r in enumerate(raw, start=1):
+        d = a.delay_s(n)
+        assert d == b.delay_s(n)                    # deterministic
+        capped = min(r, 30.0)
+        assert 0.75 * capped <= d <= 1.25 * capped  # jitter bounds
+    assert a.delay_s(0) == 0.0
+    # growth up to the cap region
+    assert a.delay_s(2) > a.delay_s(1)
+    assert a.delay_s(9) <= 30.0 * 1.25
+
+    # give-up: 3 IDENTICAL no-progress failures, but a different
+    # signature (or any progress) resets the identical count
+    p = RestartPolicy(give_up_after=3, seed=0)
+    assert p.record(("KILL", 5), made_progress=False) [1] is False
+    assert p.record(("KILL", 5), made_progress=False) [1] is False
+    _, give_up = p.record(("TERM", 5), made_progress=False)  # different
+    assert not give_up
+    assert p.record(("TERM", 5), made_progress=False)[1] is False
+    delay, give_up = p.record(("TERM", 5), made_progress=False)
+    assert give_up                                   # 3rd identical
+    # progress resets everything
+    p2 = RestartPolicy(give_up_after=2, seed=0)
+    p2.record(("KILL", 5), made_progress=False)
+    delay, give_up = p2.record(("KILL", 9), made_progress=True)
+    assert delay == 0.0 and not give_up and p2.failures == 0
+
+
+# ---- manifest topology + resume admission (elastic era) --------------------
+
+
+def test_manifest_records_topology_and_data_cursor(tmp_path):
+    from mx_rcnn_tpu.utils.checkpoint import make_topology, save_interrupt
+
+    _, _, _, state = tiny_setup()
+    prefix = str(tmp_path / "m")
+    topo = make_topology(4, num_processes=2, grad_accum=2, batch_images=1)
+    assert topo["global_batch"] == 8
+    state = state._replace(step=np.int32(10))
+    path = save_interrupt(prefix, state, 7, topology=topo)
+    m = read_manifest(path)
+    assert m["topology"] == topo
+    assert m["data_cursor"] == {"epoch": 1, "steps_in_epoch": 3,
+                                "batches_consumed": 6,
+                                "images_consumed": 80}
+
+
+def test_resume_topology_check_hard_errors_without_override(tmp_path):
+    """A resume that would silently change the effective global batch is
+    a HARD error; ft.allow_resize_resume downgrades it to a warning (the
+    elastic controller's supervised-resize path); a preserved global
+    batch (grad-accum rescale) passes without any override."""
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.tools.train import _check_topology
+
+    cfg = generate_config("tiny", "PascalVOC")
+    manifest = {"topology": {"devices": 8, "processes": 1, "grad_accum": 1,
+                             "global_batch": 8}}
+    # same global batch on fewer devices via accumulation: fine
+    _check_topology(manifest, cfg, num_devices=4, grad_accum=2, path="x")
+    # silent change: 4 devices, no rescale -> global batch 4 != 8
+    with pytest.raises(ValueError, match="global batch"):
+        _check_topology(manifest, cfg, num_devices=4, grad_accum=1,
+                        path="x")
+    # override downgrades to a warning
+    cfg_ok = cfg.replace_in("ft", allow_resize_resume=True)
+    _check_topology(manifest, cfg_ok, num_devices=4, grad_accum=1,
+                    path="x")
+    # pre-topology manifests have nothing to check against
+    _check_topology({}, cfg, num_devices=4, grad_accum=1, path="x")
+    _check_topology(None, cfg, num_devices=4, grad_accum=1, path="x")
+
+
+# ---- cross-mesh reshard round-trips (elastic state surgery) ----------------
+
+
+@pytest.mark.parametrize("hops", [(8, 4, 8), (8, 2), (4, 8)],
+                         ids=["8-4-8", "8-2", "4-8"])
+def test_reshard_roundtrip_tree_equal_and_step_bit_match(tmp_path, hops):
+    """The elastic restore path, property-tested across mesh resizes:
+    train one DP step on mesh A, checkpoint, restore + respec onto mesh
+    B (for every hop in the chain) — the restored tree must be
+    VALUE-EQUAL to the saved one (lossless surgery), ``state.step`` must
+    never move backwards, and ONE post-restore step on the new mesh must
+    bit-match a control state placed directly on that mesh."""
+    from mx_rcnn_tpu.ft.elastic import respec
+    from mx_rcnn_tpu.parallel.dp import (device_mesh, make_dp_train_step,
+                                         shard_batch)
+    from tests.test_train_step import make_batch as mk
+
+    cfg, model, tx, state = tiny_setup()
+    prefix = str(tmp_path / "xmesh")
+    batch = mk(n=8)
+
+    mesh0 = device_mesh(hops[0])
+    step0 = make_dp_train_step(model, cfg, tx, mesh0)
+    s, _ = step0(respec(jax.device_get(state), mesh0),
+                 shard_batch(_take(batch, hops[0]), mesh0), KEY)
+    host = jax.device_get(s)
+    save_checkpoint(prefix, 1, host, steps_per_epoch=100)
+    prev_step = int(np.asarray(host.step))
+
+    for n_dev in hops[1:]:
+        _, _, _, template = tiny_setup()
+        restored = restore_state(jax.tree.map(np.zeros_like,
+                                              jax.device_get(template)),
+                                 prefix, 1)
+        # lossless: tree-equal to the saved host state
+        for a, b in zip(jax.tree.leaves(host), jax.tree.leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # step monotonicity across the resize
+        assert int(np.asarray(restored.step)) >= prev_step
+        prev_step = int(np.asarray(restored.step))
+
+        mesh = device_mesh(n_dev)
+        stepN = make_dp_train_step(model, cfg, tx, mesh)
+        bN = shard_batch(_take(batch, n_dev), mesh)
+        s_restored, m_r = stepN(respec(restored, mesh), bN, KEY)
+        s_direct, m_d = stepN(respec(jax.tree.map(np.copy, host), mesh),
+                              bN, KEY)
+        assert float(m_r["loss"]) == float(m_d["loss"])
+        _assert_states_bit_equal(s_direct, s_restored)
+        # the next hop restores the same checkpoint; re-save the stepped
+        # state so the chain keeps moving forward
+        host = jax.device_get(s_restored)
+        save_checkpoint(prefix, 1, host, steps_per_epoch=100)
+
+
+def _take(batch, n):
+    """First n images of a host batch (the per-mesh global batch)."""
+    return jax.tree.map(lambda x: np.asarray(x)[:n], batch)
+
+
 def test_cached_fit_is_deterministic(tmp_path):
     """Regression pin for the double-donation aliasing bug: the cached
     step's gather index was built as a zero-copy view of state.step, and

@@ -23,3 +23,67 @@ def test_four_process_hierarchical_losses_agree():
     """Four processes x 2 CPU devices: the (dcn=4, ici=2) hierarchical mesh
     synchronizes gradients across all 8 devices (VERDICT r02 item 8)."""
     assert launch(4, steps=2) == 0
+
+
+def test_two_process_elastic_train_completes(tmp_path):
+    """REAL multi-process elastic training (docs/FT.md "Elasticity"): a
+    2-process jax.distributed world runs ``tools.train --elastic`` end to
+    end — both workers exit 0, only process 0 writes checkpoints, and
+    the final manifest records the 2-process topology.  The storm
+    (kills, shrink, grow) is ``make elastic-smoke``; this pins the
+    quiet-path wiring the storm builds on."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_COMPILATION_CACHE_DIR", "XLA_FLAGS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    # pin 1 device/process OURSELVES: the conftest exports an 8-device
+    # XLA_FLAGS that would otherwise override --local_devices
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    prefix = str(tmp_path / "m" / "e2e")
+    cmd = lambda i: [  # noqa: E731
+        sys.executable, "-m", "mx_rcnn_tpu.tools.train",
+        "--network", "tiny", "--dataset", "synthetic",
+        "--prefix", prefix, "--end_epoch", "1", "--seed", "0",
+        "--frequent", "1000", "--no_flip", "--elastic",
+        "--coordinator", f"localhost:{port}",
+        "--num_processes", "2", "--process_id", str(i),
+        "--local_devices", "1",
+        "--dataset_kw",
+        repr({"num_images": 8, "image_size": (128, 160),
+              "max_objects": 3}),
+        "--set", "train__rpn_pre_nms_top_n=1024",
+        "--set", "train__rpn_post_nms_top_n=300",
+        "--set", "train__max_gt_boxes=8",
+        "--set", "bucket__scale=128", "--set", "bucket__max_size=160",
+        "--set", "bucket__shapes=((128,160),(160,128))",
+        "--set", "elastic__base_devices=2",
+        "--root_path", str(tmp_path),
+        "--dataset_path", str(tmp_path / "synthetic")]
+    procs = [subprocess.Popen(cmd(i), env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=600)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert [p.returncode for p in procs] == [0, 0], outs
+    from mx_rcnn_tpu.utils.checkpoint import (checkpoint_path,
+                                              read_manifest)
+
+    m = read_manifest(checkpoint_path(prefix, 1))
+    assert m is not None and m["topology"]["processes"] == 2
+    assert m["topology"]["global_batch"] == 2
+    # every worker ran the same generation and emitted the timeline
+    for out in outs:
+        assert '"event": "complete"' in out, out[-2000:]
