@@ -14,7 +14,7 @@ NATIVE_LIB := $(NATIVE_DIR)/libmxrcnn_native.so
 NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 
 .PHONY: all native lint test test-all test-gate serve-smoke ft-smoke \
-	obs-smoke perf-smoke elastic-smoke clean
+	obs-smoke perf-smoke elastic-smoke data-smoke clean
 
 all: native
 
@@ -81,6 +81,18 @@ perf-smoke:
 ft-smoke:
 	python -m mx_rcnn_tpu.tools.crashloop --smoke --check --skip_overhead
 
+# streaming input-plane smoke (docs/DATA.md): a tiny streaming epoch on
+# CPU through the real path — 2-process shard rig + bounded-cache
+# streaming epoch with double-buffered staging + eval leg + real-train
+# control — fails unless every shard union is the epoch EXACTLY once,
+# per-process decode counts split ~1/N, RSS stays under the configured
+# ceiling, the timed pass lowers ZERO programs, the stage-overlap
+# counters are non-zero, and the control run's data_wait_frac ~ 0.
+# ~30 s warm.
+data-smoke:
+	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.data_bench \
+		--smoke --check --root_path data
+
 # elastic smoke (docs/FT.md "Elasticity"): a 2-process jax.distributed
 # CPU world loses one process to SIGTERM mid-epoch, shrinks onto the
 # survivor's device set (grad-accum rescaled so the global batch stays
@@ -98,9 +110,11 @@ elastic-smoke:
 # graphlint runs first: a hygiene violation fails the gate in seconds
 # instead of after 30 minutes of training; serve-smoke next (~30 s),
 # then the perf-tooling smoke (~1 min), the observability smoke
-# (~1 min), the 2-kill crash loop (ft-smoke, ~2 min) and the elastic
-# shrink/grow storm (elastic-smoke, ~3 min)
-test-gate: lint serve-smoke perf-smoke obs-smoke ft-smoke elastic-smoke
+# (~1 min), the streaming input-plane smoke (data-smoke, ~30 s), the
+# 2-kill crash loop (ft-smoke, ~2 min) and the elastic shrink/grow
+# storm (elastic-smoke, ~3 min)
+test-gate: lint serve-smoke perf-smoke obs-smoke data-smoke ft-smoke \
+		elastic-smoke
 	python -m pytest tests/ -x -q -m "gate"
 
 clean:

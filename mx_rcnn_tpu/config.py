@@ -248,6 +248,52 @@ class BucketConfig:
 
 
 @dataclass(frozen=True)
+class DataConfig:
+    """TPU addition (no reference equivalent — the reference loader is a
+    synchronous in-process iterator over a fully-materialized roidb):
+    policy knobs for the STREAMING input plane (docs/DATA.md) — sharded
+    loaders, bounded-memory decode windows, and double-buffered
+    host→device staging.
+
+    Same 3-level precedence as every section (hardcoded defaults <
+    presets < ``--set data__field=value`` CLI overrides).
+    """
+
+    # use the topology-invariant streaming loader (``data/loader.py —
+    # StreamLoader``) for training: image-granular epoch plan that is a
+    # pure function of (seed, epoch), so shard unions and mid-epoch
+    # resumes stay exactly-once across ANY worker/process/accum
+    # topology.  False keeps the classic AnchorLoader plan (bit-pinned
+    # by the pre-r7 resume tests); multi-process worlds shard the
+    # classic plan by batch ROWS either way, so N processes decode 1/N
+    # of the data in both modes.
+    streaming: bool = False
+    # double-buffered host→device staging (``data/staging.py``): a
+    # background thread assembles + device_puts the NEXT batch(es)
+    # while the in-flight step runs, so the fit loop's data_wait gauge
+    # goes to ~0 even when the dataset does not fit in HBM.  The
+    # device-cache path (``--device_cache``) stays the small-set fast
+    # path and bypasses staging entirely.
+    staging: bool = True
+    # device-resident batches kept in flight by the stager (>= 1;
+    # each costs one batch of HBM — 2 = classic double buffering)
+    stage_depth: int = 2
+    # RAM ceiling for the host input plane in MiB (0 = unlimited): the
+    # decoded-image cache budget and the per-worker shares are clamped
+    # so cache + prefetch window + process floor fit under it
+    # (``data/loader.py — stream_cache_budget``, logged once at loader
+    # build), and ``tools/data_bench.py --check`` fails if measured RSS
+    # exceeds it.
+    ram_ceiling_mb: int = 0
+    # NOTE deliberately NO shard_id/num_shards knobs here: training
+    # derives loader-shard ownership from the process topology alone
+    # (``tools/train.py`` — a lone training process given a shard would
+    # silently train on 1/N of every batch), and bench rigs pass shard
+    # ownership explicitly (``tools/data_bench.py --shard_id/--num_shards``
+    # CLI, ``StreamLoader(shard=...)`` API).
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """TPU addition (no reference equivalent — the reference has no online
     inference path at all): policy knobs for the ``mx_rcnn_tpu/serve/``
@@ -396,6 +442,7 @@ class Config:
     dataset: DatasetConfig = field(default_factory=DatasetConfig)
     default: DefaultConfig = field(default_factory=DefaultConfig)
     bucket: BucketConfig = field(default_factory=BucketConfig)
+    data: DataConfig = field(default_factory=DataConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
     ft: FTConfig = field(default_factory=FTConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
@@ -479,6 +526,17 @@ _DATASETS: Mapping[str, Mapping[str, Any]] = {
         dataset_path="data/synthetic_hard",
         num_classes=9,
     ),
+    # COCO-cardinality rehearsal set (data/synthetic.py —
+    # StreamSyntheticDataset): 80 fg classes, 10k/1k images — sized so
+    # input-plane claims are tested at production cardinality, not on
+    # sets that fit in HBM (docs/DATA.md)
+    "synthetic_stream": dict(
+        name="synthetic_stream",
+        image_set="train",
+        test_image_set="test",
+        dataset_path="data/synthetic_stream",
+        num_classes=81,
+    ),
 }
 
 # Per-dataset bucket presets (TPU addition): synthetic canvases are
@@ -489,6 +547,8 @@ _DATASET_BUCKETS: Mapping[str, Mapping[str, Any]] = {
                       shapes=((320, 416), (416, 320))),
     "synthetic_hard": dict(scale=240, max_size=320,
                            shapes=((240, 320), (320, 240))),
+    "synthetic_stream": dict(scale=240, max_size=320,
+                             shapes=((240, 320), (320, 240))),
 }
 
 

@@ -129,6 +129,7 @@ def fit(
     run_record=None,
     grad_accum: int = 1,
     multiproc: bool = False,
+    data_cursor: Optional[Dict] = None,
 ) -> TrainState:
     """Run ``begin_epoch .. num_epochs`` epochs; checkpoint per epoch.
 
@@ -188,8 +189,23 @@ def fit(
     ``multiproc``: the mesh spans multiple ``jax.distributed`` processes
     (``parallel/multihost.py``): state replication and batch assembly go
     through ``multihost_utils`` (every process feeds only its local image
-    slice of the deterministic global batch), and only process 0 writes
-    checkpoints (state is replicated, so host 0 holds the full values).
+    slice of the deterministic global batch — decoded by the loader's own
+    row shard when one is set, sliced host-side otherwise), and only
+    process 0 writes checkpoints (state is replicated, so host 0 holds
+    the full values).
+    ``data_cursor``: the checkpoint manifest's data-shard cursor (PR 6
+    recorded it; ``tools/train.py --resume auto`` now consumes it) —
+    ``{"loader_batch_images": N}`` names the batch size of the run that
+    WROTE the checkpoint, so a loader with ``resume_at`` (the streaming
+    loader) can replay that run's plan and continue the epoch
+    exactly-once even across a topology change.
+    With ``cfg.data.staging`` (the default), batches are double-buffered
+    host→device by a background thread (``data/staging.py``): the next
+    batch's assembly + ``device_put`` overlap the in-flight step, so
+    ``train.data_wait_frac`` goes to ~0 without requiring the dataset to
+    fit in HBM.  Skipped automatically for the device-cache path (no
+    host batches) and multiproc (global-array assembly is collective and
+    stays on the step thread).
     """
     frequent = cfg.default.frequent if frequent is None else frequent
     # -- observability wiring (cfg.obs.enabled; docs/OBSERVABILITY.md) --
@@ -221,6 +237,11 @@ def fit(
             "(the HBM epoch cache gathers exactly one batch per step, "
             "single process) — use the streaming loader for elastic runs")
     cache = None
+    # host→device staging placement (data/staging.py): set by the two
+    # single-process branches below; stays None for the device-cache
+    # path (no host batches to stage) and multiproc (collective global
+    # assembly must run on the step thread)
+    stage_place = None
     if device_cache:
         import jax.numpy as jnp
 
@@ -277,23 +298,37 @@ def fit(
             # the mesh spans processes: device_put cannot address remote
             # devices, so replication and batch assembly go through
             # multihost_utils (parallel/multihost.py).  Every process
-            # iterates the same deterministic loader and contributes only
-            # its own image slice (rows [pid*per, (pid+1)*per) of the
-            # image axis) — identical math to single-process DP.
+            # contributes only its own image slice (rows [pid*per,
+            # (pid+1)*per) of the image axis) — identical math to
+            # single-process DP.  With a loader row shard (the r7
+            # sharded input plane — tools/train.py sets it from the
+            # process topology) the batch IS the local slice already
+            # and each process decoded only 1/N of the epoch; without
+            # one, every process decodes the full batch and slices it
+            # host-side (the pre-r7 fallback).
             from mx_rcnn_tpu.parallel import multihost
 
             state = multihost.replicate_global(jax.device_get(state), mesh)
 
             def run_step(state, batch: Batch):
-                gbatch = multihost.global_batch(
-                    multihost.local_image_slice(batch, accum=grad_accum > 1),
-                    mesh, accum=grad_accum > 1)
+                # read the shard LIVE (set_shard may remap between
+                # epochs): a sharded loader already yields local rows
+                local = (batch
+                         if getattr(train_loader, "shard", None) is not None
+                         else multihost.local_image_slice(
+                             batch, accum=grad_accum > 1))
+                gbatch = multihost.global_batch(local, mesh,
+                                                accum=grad_accum > 1)
                 return step_fn(state, gbatch, key)
         else:
             state = replicate(state, mesh)
             place = (shard_batch if grad_accum <= 1 else shard_accum_batch)
+            stage_place = lambda b: place(b, mesh)  # noqa: E731
 
             def run_step(state, batch: Batch):
+                # a staged batch is already mesh-placed; device_put with
+                # an identical sharding is a no-op, so one place() serves
+                # both the staged and unstaged paths
                 return step_fn(state, place(batch, mesh), key)
     else:
         from mx_rcnn_tpu.parallel.dp import own_leaves
@@ -305,6 +340,7 @@ def fit(
         # msgpack buffer); the jitted step DONATES arg 0 — force
         # private jax-owned copies first (parallel/dp.py — own_leaves)
         state = own_leaves(state)
+        stage_place = jax.device_put
 
         def run_step(state, batch: Batch):
             return base(state, batch, key)
@@ -324,6 +360,10 @@ def fit(
     steps_per_epoch = (len(train_loader) // grad_accum if grad_accum > 1
                        else len(train_loader))
     done_steps = int(jax.device_get(state.step))
+    data_cfg = getattr(cfg, "data", None)
+    if data_cfg is None or not data_cfg.staging:
+        stage_place = None
+    stager = None
     snap = None
     if prefix is not None and not (multiproc and jax.process_index() != 0):
         from mx_rcnn_tpu.ft.snapshot import make_snapshotter
@@ -362,7 +402,25 @@ def fit(
             else:
                 skip_b = skip * grad_accum  # loader batches, not opt steps
                 loader_skips = hasattr(train_loader, "skip_next_batches")
-                if skip_b and loader_skips:
+                if skip_b and hasattr(train_loader, "resume_at"):
+                    # streaming loader: position by the data cursor —
+                    # the recording run's batch size lets the loader
+                    # replay ITS plan, so the continued epoch is
+                    # exactly-once even across an elastic topology
+                    # change (docs/DATA.md; plain skip trims the
+                    # CURRENT plan, which is only identical for the
+                    # same topology).  images_consumed_in_epoch comes
+                    # from state.step under the OLD topology
+                    # (tools/train.py); the new-topology product below
+                    # is the fallback for direct fit() callers and is
+                    # only equal when the global batch is unchanged.
+                    cur = data_cursor or {}
+                    images = cur.get("images_consumed_in_epoch")
+                    if images is None:
+                        images = skip_b * train_loader.batch_images
+                    train_loader.resume_at(
+                        images, cur.get("loader_batch_images"))
+                elif skip_b and loader_skips:
                     train_loader.skip_next_batches(skip_b)  # trims the order
                 batch_iter = iter(train_loader)
                 if skip_b and not loader_skips:
@@ -370,6 +428,15 @@ def fit(
                         next(batch_iter, None)
                 if grad_accum > 1:
                     batch_iter = _accum_iter(batch_iter, grad_accum)
+                if stage_place is not None:
+                    from mx_rcnn_tpu.data.staging import DeviceStager
+
+                    # double-buffer host→device: assembly + device_put of
+                    # batch k+1 overlap step k (docs/DATA.md)
+                    stager = DeviceStager(batch_iter, stage_place,
+                                          depth=data_cfg.stage_depth,
+                                          rec=rec)
+                    batch_iter = iter(stager)
             if run_record is not None:
                 run_record.event("epoch_start", epoch=epoch, skip=skip,
                                  steps_per_epoch=steps_per_epoch)
@@ -399,8 +466,14 @@ def fit(
                     rec.inc("train.steps")
                     rec.observe("train.step_ms", step_s * 1e3)
                     rec.observe("train.data_wait_ms", wait_s * 1e3)
-                    rec.set_gauge("train.data_wait_frac",
-                                  wait_s / max(step_s, 1e-9))
+                    frac = wait_s / max(step_s, 1e-9)
+                    rec.set_gauge("train.data_wait_frac", frac)
+                    # the PER-STEP fraction as a distribution (percent
+                    # scale for the log-bucket range): p50 of this is
+                    # the honest data_wait_frac statistic — a ratio of
+                    # independent wait/step percentiles is not
+                    rec.observe("train.data_wait_frac_pct", 100.0 * frac,
+                                lo=0.01, hi=1000.0)
                 window.append(metrics)
                 nbatch += 1
                 if tracing and nbatch >= skip + 5:
@@ -464,6 +537,9 @@ def fit(
                             **avg)
                 else:
                     speedo(epoch, nbatch, {})
+            if stager is not None:  # epoch drained: join the stage thread
+                stager.close()
+                stager = None
             if tracing:  # epoch shorter than the trace window
                 jax.block_until_ready(metrics)
                 jax.profiler.stop_trace()
@@ -508,6 +584,8 @@ def fit(
                 return state
         return state
     finally:
+        if stager is not None:
+            stager.close()  # early return/error: release the stage thread
         if prof is not None:
             prof.close()  # run shorter than the window: close it cleanly
         if snap is not None:

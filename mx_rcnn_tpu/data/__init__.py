@@ -17,12 +17,15 @@ TPU-native differences:
 from mx_rcnn_tpu.data.cache import DecodedImageCache  # noqa: F401
 from mx_rcnn_tpu.data.image import load_and_transform, resize_to_bucket  # noqa: F401
 from mx_rcnn_tpu.data.loader import (AnchorLoader, ROITestLoader,  # noqa: F401
-                                     TestLoader, cache_from_config,
-                                     decode_pool_from_config)
+                                     StreamLoader, TestLoader,
+                                     cache_from_config,
+                                     decode_pool_from_config,
+                                     stream_cache_budget)
 from mx_rcnn_tpu.data.roidb import IMDB, filter_roidb, merge_roidbs  # noqa: F401
 from mx_rcnn_tpu.data.pascal_voc import PascalVOC  # noqa: F401
 from mx_rcnn_tpu.data.coco import COCODataset  # noqa: F401
 from mx_rcnn_tpu.data.synthetic import (HardSyntheticDataset,  # noqa: F401
+                                        StreamSyntheticDataset,
                                         SyntheticDataset)
 
 
@@ -35,6 +38,7 @@ def get_dataset(name: str, image_set: str, root_path: str, dataset_path: str,
         "coco": COCODataset,
         "synthetic": SyntheticDataset,
         "synthetic_hard": HardSyntheticDataset,
+        "synthetic_stream": StreamSyntheticDataset,
     }
     if name not in table:
         raise KeyError(f"unknown dataset {name!r}; have {sorted(table)}")
@@ -61,7 +65,7 @@ def load_gt_roidb(cfg, image_set: str = None, training: bool = True,
         # silently drop the later sets from the reported mAP
         raise ValueError(
             f"'+'-joined image sets are train-only; got {image_set!r}")
-    if ds.name in ("synthetic", "synthetic_hard"):
+    if ds.name in ("synthetic", "synthetic_hard", "synthetic_stream"):
         kw.setdefault("num_classes", ds.num_classes)
     imdbs, roidbs = [], []
     for sset in image_set.split("+"):

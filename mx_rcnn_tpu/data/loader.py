@@ -19,11 +19,14 @@ TPU-native differences:
 from __future__ import annotations
 
 import logging
+import threading
 import time
+import zlib
 
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Iterable, Iterator, List, Sequence, Tuple
+from typing import (Callable, Dict, Iterable, Iterator, List, Optional,
+                    Sequence, Tuple)
 
 import numpy as np
 
@@ -35,16 +38,82 @@ from mx_rcnn_tpu.data.image import (choose_bucket, compute_scale,
 from mx_rcnn_tpu.data.roidb import Roidb
 
 
-def cache_from_config(cfg: Config) -> DecodedImageCache | None:
-    """Build the decoded-image cache the config asks for (None = disabled)."""
+# host baseline reserved under data.ram_ceiling_mb before any cache
+# budget is granted: interpreter + jax/XLA runtime + code + loader
+# scratch.  Measured ~0.6-0.9 GB on this box with the CPU backend up;
+# 1 GiB keeps the derivation conservative (docs/DATA.md "RAM ceiling").
+_PROCESS_FLOOR_BYTES = 1 << 30
+
+
+def stream_cache_budget(cfg: Config, n_images: Optional[int] = None,
+                        image_bytes: Optional[int] = None,
+                        batch_bytes: int = 0) -> int:
+    """The decoded-image cache RAM budget in BYTES, derived from the
+    bounded working set instead of the raw ``default.image_cache_mb``
+    number (extends the PR 1 clamp, which only guarded the per-worker
+    split of an unexamined total).
+
+    Three clamps, applied in order and logged once:
+
+    * the configured ``default.image_cache_mb`` is the ceiling ask,
+    * ``n_images * image_bytes`` (decoded size of the WHOLE set at the
+      bucket resolution) caps it — a 64-image smoke must not reserve the
+      2 GiB a COCO-scale run wants,
+    * under ``data.ram_ceiling_mb``, the cache gets what remains after
+      the process floor (~1 GiB interpreter + runtime) and the streaming
+      window (prefetch depth + assembly workers + stage depth, one batch
+      each) are reserved — the streaming loader's RSS stays bounded no
+      matter how big the epoch is (``tools/data_bench.py`` measures it
+      against the ceiling).
+    """
+    d = cfg.default
+    budget = d.image_cache_mb << 20
+    if budget <= 0:
+        return 0
+    why = [f"configured={d.image_cache_mb}MB"]
+    if n_images and image_bytes:
+        dataset = int(n_images) * int(image_bytes)
+        if dataset < budget:
+            budget = dataset
+            why.append(f"dataset={dataset >> 20}MB ({n_images} images)")
+    data = getattr(cfg, "data", None)
+    ceiling = (data.ram_ceiling_mb << 20) if data is not None else 0
+    if ceiling > 0:
+        depth = data.stage_depth if data.staging else 0
+        window = (d.prefetch + max(d.num_workers, 1) + depth + 1) \
+            * max(int(batch_bytes), 0)
+        room = max(ceiling - _PROCESS_FLOOR_BYTES - window, 0)
+        if room < budget:
+            budget = room
+            why.append(f"ceiling={data.ram_ceiling_mb}MB - floor "
+                       f"{_PROCESS_FLOOR_BYTES >> 20}MB - window "
+                       f"{window >> 20}MB")
+    logging.getLogger("mx_rcnn_tpu").info(
+        "decoded-image cache budget: %d MB (%s)", budget >> 20,
+        ", ".join(why))
+    return budget
+
+
+def cache_from_config(cfg: Config, n_images: Optional[int] = None,
+                      image_bytes: Optional[int] = None,
+                      batch_bytes: int = 0) -> DecodedImageCache | None:
+    """Build the decoded-image cache the config asks for (None = disabled).
+    With ``n_images``/``image_bytes`` the RAM tier is budgeted from the
+    bounded streaming window (:func:`stream_cache_budget`) instead of the
+    raw config number."""
     d = cfg.default
     if d.image_cache_mb <= 0 and not d.image_cache_dir:
         return None
-    return DecodedImageCache(ram_bytes=d.image_cache_mb << 20,
+    budget = stream_cache_budget(cfg, n_images, image_bytes, batch_bytes)
+    if budget <= 0 and not d.image_cache_dir:
+        return None
+    return DecodedImageCache(ram_bytes=budget,
                              cache_dir=d.image_cache_dir or None)
 
 
-def decode_pool_from_config(cfg: Config):
+def decode_pool_from_config(cfg: Config, n_images: Optional[int] = None,
+                            image_bytes: Optional[int] = None,
+                            batch_bytes: int = 0):
     """Build the process decode pool the config asks for (None = in-thread
     decode).  Callers own the pool: close() it when the loaders are done
     (``tools/train.py`` wraps fit in try/finally)."""
@@ -54,24 +123,27 @@ def decode_pool_from_config(cfg: Config):
     from mx_rcnn_tpu.data.decode_pool import DecodePool
 
     # decode runs in the worker processes, so the RAM tier must live there
-    # too: split the configured budget across workers (the parent's cache
-    # is never consulted on this path — advisor r4).  The disk tier stays
-    # shared via cache_dir.
-    per_worker = (d.image_cache_mb << 20) // d.decode_procs
-    if d.image_cache_mb > 0 and per_worker < (1 << 20):
+    # too: split the budget across workers (the parent's cache is never
+    # consulted on this path — advisor r4).  The budget itself is the
+    # window-derived stream_cache_budget, NOT the raw config number, so
+    # the total across workers stays bounded for streaming sets too.
+    # The disk tier stays shared via cache_dir.
+    total = stream_cache_budget(cfg, n_images, image_bytes, batch_bytes)
+    per_worker = total // d.decode_procs
+    if total > 0 and per_worker < (1 << 20):
         # an integer-division share of 0 would silently disable the RAM
         # tier the config asked for (ADVICE r5); clamp to a useful floor
         logging.getLogger("mx_rcnn_tpu").warning(
-            "image_cache_mb=%d split across decode_procs=%d leaves under "
+            "cache budget %d MB split across decode_procs=%d leaves under "
             "1 MB per worker; clamping each worker's RAM tier to 1 MB "
-            "(raise image_cache_mb to at least decode_procs to silence)",
-            d.image_cache_mb, d.decode_procs)
+            "(raise image_cache_mb / data.ram_ceiling_mb to silence)",
+            total >> 20, d.decode_procs)
         per_worker = 1 << 20
-    if d.image_cache_mb > 0:
+    if total > 0:
         logging.getLogger("mx_rcnn_tpu").info(
-            "decode_procs=%d: image_cache_mb=%d RAM tier moves into the "
-            "workers at %d MB each (total RSS budget unchanged)",
-            d.decode_procs, d.image_cache_mb, per_worker >> 20)
+            "decode_procs=%d: %d MB RAM tier moves into the workers at "
+            "%d MB each (total RSS budget unchanged)",
+            d.decode_procs, total >> 20, per_worker >> 20)
     return DecodePool(d.decode_procs, cache_dir=d.image_cache_dir or None,
                       ram_bytes=per_worker)
 
@@ -104,6 +176,15 @@ class _ImageSource:
             from mx_rcnn_tpu.obs.metrics import registry
 
             self._rec = registry()
+        # decode accounting (docs/DATA.md): images_decoded counts every
+        # image THIS loader decoded (the per-process shard-ownership
+        # measurement); record_decodes() additionally collects the
+        # (roidb index, flipped) identity of each — the exactly-once
+        # audit the streaming invariants are checked against.  Guarded
+        # by a lock: _images_into runs on the prefetch pool's threads.
+        self.images_decoded = 0
+        self.decoded_ids: Optional[List[Tuple[int, bool]]] = None
+        self._decode_count_lock = threading.Lock()
 
     def _write_slot(self, out: np.ndarray, img: np.ndarray) -> Tuple[int, int]:
         h, w = img.shape[:2]
@@ -140,8 +221,15 @@ class _ImageSource:
         derived parent-side from the record geometry (``plan_scale`` is
         pinned equal to the decode path's scale); without one, the decode
         runs in-thread through the optional cache."""
+        with self._decode_count_lock:
+            self.images_decoded += len(recs)
+            if self.decoded_ids is not None:
+                self.decoded_ids.extend(
+                    (int(rec.get("index", -1)),
+                     bool(rec.get("flipped", False))) for rec in recs)
         if self._rec is None:
             return self._decode_into(images, recs, bucket)
+        self._rec.inc("loader.images_decoded", len(recs))
         t0 = time.perf_counter()
         out = self._decode_into(images, recs, bucket)
         self._rec.observe("loader.decode_ms",
@@ -169,6 +257,13 @@ class _ImageSource:
     def _image_buffer(self, n: int, bucket) -> np.ndarray:
         dtype = np.uint8 if self.raw_images else np.float32
         return np.zeros((n, bucket[0], bucket[1], 3), dtype)
+
+    def record_decodes(self, on: bool = True) -> None:
+        """Start (or stop) collecting the (roidb index, flipped) identity
+        of every decoded image — the exactly-once audit used by the
+        streaming tests and ``tools/data_bench.py``."""
+        with self._decode_count_lock:
+            self.decoded_ids = [] if on else None
 
 
 def _prefetched(work: Iterable, make: Callable, num_workers: int,
@@ -275,7 +370,7 @@ class AnchorLoader(_ImageSource):
                  shuffle: bool = True, seed: int = 0,
                  num_workers: int = None, prefetch: int = None,
                  raw_images: bool = None, cache: DecodedImageCache = None,
-                 decode_pool=None):
+                 decode_pool=None, shard: Tuple[int, int] = None):
         self.roidb = list(roidb)
         self.cfg = cfg
         self._init_source(cfg, raw_images, cache, decode_pool)
@@ -288,6 +383,9 @@ class AnchorLoader(_ImageSource):
                          else prefetch)
         self._epoch = 0
         self._skip_next = 0
+        self.shard = None
+        if shard is not None:
+            self.set_shard(*shard)
         b = cfg.bucket
         self.buckets = tuple(tuple(s) for s in b.shapes)
         self._bucket_ids = [
@@ -324,6 +422,41 @@ class AnchorLoader(_ImageSource):
                 gt_classes[j, :k] = rec["gt_classes"][:k]
                 gt_valid[j, :k] = True
         return Batch(images, im_info, gt_boxes, gt_classes, gt_valid)
+
+    def set_shard(self, shard_id: int, num_shards: int) -> None:
+        """Own rows ``[shard_id * per, (shard_id + 1) * per)`` of every
+        batch, ``per = batch_images / num_shards`` (docs/DATA.md).
+
+        The batch PLAN stays the global one — identical on every process
+        for a given (seed, epoch) — and each process decodes only its
+        row slice, so the union of all shards' yields is bit-identical
+        to the unsharded batches and an N-process world decodes 1/N of
+        the epoch instead of all of it.  ``num_shards <= 0`` clears the
+        shard (own everything); callable between epochs for resize-time
+        remaps (ft/elastic.py relaunch path).
+        """
+        if num_shards is None or num_shards <= 1:
+            self.shard = None
+            return
+        if not 0 <= shard_id < num_shards:
+            raise ValueError(
+                f"shard_id={shard_id} out of range for {num_shards} shards")
+        if self.batch_images % num_shards:
+            raise ValueError(
+                f"batch_images={self.batch_images} is not divisible by "
+                f"num_shards={num_shards} — rows cannot be owned evenly "
+                f"(choose a divisor topology)")
+        self.shard = (int(shard_id), int(num_shards))
+
+    def _shard_rows(self, batches: List) -> List:
+        """Slice this shard's rows out of every global (bucket, indices)
+        batch plan entry (no-op without a shard)."""
+        if self.shard is None:
+            return batches
+        sid, n = self.shard
+        per = self.batch_images // n
+        return [(bucket, idx[sid * per:(sid + 1) * per])
+                for bucket, idx in batches]
 
     def set_epoch(self, epoch: int) -> None:
         """Pin the shuffle order of the NEXT iteration to ``epoch``.
@@ -365,7 +498,190 @@ class AnchorLoader(_ImageSource):
             batches = batches[self._skip_next:]
             self._skip_next = 0
         yield from _prefetched(
-            batches, lambda b: self._make_batch(b[1], b[0]),
+            self._shard_rows(batches),
+            lambda b: self._make_batch(b[1], b[0]),
+            self.num_workers, self.prefetch, rec=self._rec)
+
+
+class StreamLoader(AnchorLoader):
+    """Sharded streaming training loader with a TOPOLOGY-INVARIANT epoch
+    plan (docs/DATA.md; ``cfg.data.streaming`` selects it).
+
+    :class:`AnchorLoader`'s plan shuffles per-bucket index lists, chunks
+    them into batches, then shuffles the BATCH list — the final shuffle
+    consumes RNG state that depends on the batch count, so two
+    topologies (different ``batch_images`` after an elastic resize, or
+    different grad-accum splits) see different image ORDERS and a
+    mid-epoch cursor cannot transfer between them.  This loader's plan
+    is a pure function of (seed, epoch) at IMAGE granularity:
+
+    * each bucket's epoch order comes from its own RNG seeded
+      ``(seed, epoch, bucket)`` — invariant to batch size, worker count,
+      shard count and process count,
+    * batches are consecutive chunks of each bucket's stream; the
+      global batch sequence interleaves buckets by largest-remaining-
+      fraction (deterministic, computable from image counts + batch
+      size alone),
+    * a shard owns rows of every batch exactly like the parent class.
+
+    Consequences: the first K images consumed are the same SET under
+    any batch size that divides K, so :meth:`resume_at` can reposition
+    mid-epoch across a topology change — it replays the plan the
+    CHECKPOINT-WRITING run used (its ``loader_batch_images`` from the
+    manifest data cursor) to find each bucket's consumed prefix, then
+    chunks the remainder at the CURRENT batch size.  Shard unions and
+    kill/resume unions are each-image-exactly-once per epoch
+    (tests/test_streaming.py pins 1/2/4-way shards, worker counts and
+    shrink-mid-epoch remaps).
+
+    Remainder semantics: images beyond the last full batch of a
+    bucket's stream are dropped for the epoch (the parent's contract);
+    a resume under a SMALLER batch size can cover part of that tail —
+    never a duplicate, and exactly-once whenever batch size divides the
+    bucket populations (the rehearsal and smoke configurations).
+
+    Disclosed limitation (multi-bucket only): the cursor records images
+    consumed, not per-bucket offsets, so :meth:`resume_at` reconstructs
+    consumption by replaying ONE plan at the cursor's batch size.  After
+    TWO kills in the SAME epoch with a topology change in between, the
+    writing run's actual consumption was a mix of two plans and the
+    replay can mis-split it across buckets — exactly-once then holds
+    per bucket-stream prefix, not globally, until the epoch boundary
+    resets everything.  Single-bucket datasets (every current synthetic
+    set) are immune: the offset IS the image count.
+    """
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._resume: Optional[Tuple[int, int]] = None
+
+    # -- the plan ------------------------------------------------------------
+    def _bucket_orders(self, epoch: int) -> Dict[Tuple[int, int], List[int]]:
+        """{bucket: per-epoch image order} — each bucket's order from its
+        OWN rng, so it never depends on how other buckets consumed RNG
+        state (the invariance root)."""
+        orders = {}
+        for bucket in sorted(set(self._bucket_ids)):
+            idx = self._indices_for(bucket)
+            if self.shuffle:
+                s = zlib.crc32(
+                    f"{self.seed}:{epoch}:{bucket[0]}x{bucket[1]}".encode()
+                ) % (2 ** 31)
+                np.random.RandomState(s).shuffle(idx)
+            orders[bucket] = idx
+        return orders
+
+    @staticmethod
+    def _interleave(counts: Dict) -> List:
+        """Deterministic bucket sequence: always draw the bucket with the
+        largest remaining fraction of its own batches (stable tie-break
+        on the bucket tuple), so buckets drain proportionally and the
+        sequence depends only on the per-bucket batch counts."""
+        remaining = {b: n for b, n in counts.items() if n > 0}
+        totals = dict(remaining)
+        seq = []
+        while remaining:
+            bucket = max(sorted(remaining),
+                         key=lambda b: remaining[b] / totals[b])
+            seq.append(bucket)
+            remaining[bucket] -= 1
+            if not remaining[bucket]:
+                del remaining[bucket]
+        return seq
+
+    def _plan(self, epoch: int, batch_images: int,
+              offsets: Optional[Dict] = None) -> List:
+        """The epoch's global batch plan [(bucket, indices), ...] for a
+        given batch size, optionally starting each bucket's stream at a
+        consumed-prefix ``offsets[bucket]``."""
+        orders = self._bucket_orders(epoch)
+        off = dict(offsets or {})
+        streams = {b: o[off.get(b, 0):] for b, o in orders.items()}
+        counts = {b: len(s) // batch_images for b, s in streams.items()}
+        pos = {b: 0 for b in streams}
+        plan = []
+        for bucket in self._interleave(counts):
+            p = pos[bucket]
+            plan.append((bucket, streams[bucket][p:p + batch_images]))
+            pos[bucket] = p + batch_images
+        return plan
+
+    # __len__ is inherited: full batches per bucket, identical formula
+
+    # -- cursor resume -------------------------------------------------------
+    def resume_at(self, images_consumed: int,
+                  old_batch_images: int = None) -> None:
+        """Position the NEXT iteration after ``images_consumed`` images of
+        the epoch (pin the epoch first via :meth:`set_epoch`).
+
+        ``old_batch_images`` is the batch size of the run that recorded
+        the cursor (``manifest.data_cursor`` via ``topology.global_batch
+        / grad_accum``); it defaults to the current size.  The consumed
+        prefix is found by replaying the OLD plan, so the resumed epoch
+        continues exactly where the killed run stopped even when the
+        topology (and therefore the batch size) changed in between."""
+        images = int(images_consumed)
+        old_bi = int(old_batch_images or self.batch_images)
+        if images % old_bi:
+            raise ValueError(
+                f"cursor images_consumed={images} is not a multiple of "
+                f"the recording run's batch_images={old_bi} — the cursor "
+                f"does not sit on a batch boundary")
+        self._resume = (images, old_bi)
+
+    def _consumed_offsets(self, epoch: int, images: int,
+                          old_bi: int) -> Dict:
+        """Per-bucket consumed-image counts after ``images`` images of the
+        epoch under the OLD plan (batch size ``old_bi``; the batch-
+        boundary modulo was validated in :meth:`resume_at`)."""
+        plan = self._plan(epoch, old_bi)
+        nb = images // old_bi
+        if nb > len(plan):
+            raise ValueError(
+                f"cursor consumed {nb} batches but the epoch only has "
+                f"{len(plan)} at batch_images={old_bi} — wrong epoch or "
+                f"wrong dataset")
+        offsets: Dict = {}
+        for bucket, idx in plan[:nb]:
+            offsets[bucket] = offsets.get(bucket, 0) + len(idx)
+        return offsets
+
+    def _epoch_plan(self, epoch: int) -> List:
+        """The batch plan the next iteration will run, with any pending
+        resume/skip applied (consumed here; split from ``__iter__`` so
+        plan semantics are testable without decoding pixels)."""
+        plan = None
+        if self._resume is not None:
+            images, old_bi = self._resume
+            self._resume = None
+            if old_bi == self.batch_images:
+                # same topology: trim the ORIGINAL plan, preserving the
+                # uninterrupted run's exact tail order (re-interleaving
+                # the remainder would keep the SET but reorder batches,
+                # breaking step-exact resume on multi-bucket sets).
+                # The batch-boundary modulo was validated in resume_at.
+                plan = self._plan(epoch, old_bi)[images // old_bi:]
+            else:
+                # topology changed: find each bucket stream's consumed
+                # prefix under the OLD plan, re-chunk the remainder at
+                # the current size (exactly-once; batch ORDER is the
+                # new topology's — there is no old order to preserve)
+                offsets = self._consumed_offsets(epoch, images, old_bi)
+                plan = self._plan(epoch, self.batch_images, offsets)
+        if plan is None:
+            plan = self._plan(epoch, self.batch_images)
+        if self._skip_next:  # same-topology skip (fit's generic fallback)
+            plan = plan[self._skip_next:]
+            self._skip_next = 0
+        return plan
+
+    def __iter__(self) -> Iterator[Batch]:
+        epoch = self._epoch
+        self._epoch += 1
+        plan = self._epoch_plan(epoch)
+        yield from _prefetched(
+            self._shard_rows(plan),
+            lambda b: self._make_batch(b[1], b[0]),
             self.num_workers, self.prefetch, rec=self._rec)
 
 
@@ -386,11 +702,11 @@ class ROIIter(AnchorLoader):
                  seed: int = 0, max_rois: int = None,
                  num_workers: int = None, prefetch: int = None,
                  raw_images: bool = None, cache: DecodedImageCache = None,
-                 decode_pool=None):
+                 decode_pool=None, shard: Tuple[int, int] = None):
         super().__init__(roidb, cfg, batch_images, shuffle, seed,
                          num_workers=num_workers, prefetch=prefetch,
                          raw_images=raw_images, cache=cache,
-                         decode_pool=decode_pool)
+                         decode_pool=decode_pool, shard=shard)
         self.proposals = _check_proposals(proposals, self.roidb)
         self.max_rois = max_rois or cfg.test.proposal_post_nms_top_n
 

@@ -391,9 +391,17 @@ def run_elastic(cfg, *, prefix: str, end_epoch: Optional[int] = None,
                           global_batch=devices * cfg.train.batch_images
                           * accum)
         last_accum = accum
+        # loader-shard ownership rides the process topology (docs/DATA.md:
+        # train_net gives each process the row shard (pid, nproc), so a
+        # world resize REMAPS shards simply by relaunching at the new
+        # size — the topology-invariant streaming plan keeps the epoch
+        # exactly-once across the remap).  Emitted so the supervisor's
+        # timeline shows who owns which slice each generation.
+        pid = jax.process_index() if multiproc else 0
         ctrl.emit("mesh", generation=directive.generation,
                   num_devices=devices, num_processes=nproc,
-                  grad_accum=accum, base_devices=base)
+                  grad_accum=accum, base_devices=base,
+                  loader_shard=[pid, nproc])
 
         # restore verification + first-step recovery timing hooks; the
         # lowering counter opens BEFORE the first step so every

@@ -92,11 +92,17 @@ def local_image_slice(batch, accum: bool = False):
     """This process's contiguous slice of a GLOBAL batch's image axis
     (axis 0, or axis 1 for accumulation batches): processes iterate the
     same deterministic loader and each feeds rows
-    ``[pid * per, (pid + 1) * per)`` into :func:`global_batch` —
-    decode work is duplicated per process (disclosed in docs/FT.md
-    "Elasticity"; the dataset-scale loader-sharding story is ROADMAP
-    item 3), but the assembled global batch is bit-identical to the
-    single-process one, which is what keeps elastic resumes on-recipe."""
+    ``[pid * per, (pid + 1) * per)`` into :func:`global_batch`.
+
+    FALLBACK path since r7: it slices a batch every process fully
+    DECODED, so decode work is duplicated N-fold.  The fit loop now
+    prefers loader row shards (``data/loader.py — set_shard``, wired by
+    ``tools/train.py`` from the process topology), where each process
+    decodes only its own rows — same rows, same bytes, 1/N the decode
+    (docs/DATA.md).  This slice remains for loaders without shard
+    support; either way the assembled global batch is bit-identical to
+    the single-process one, which is what keeps elastic resumes
+    on-recipe."""
     pid, n = jax.process_index(), jax.process_count()
     axis = 1 if accum else 0
 

@@ -1,0 +1,464 @@
+"""Dataset-scale input-plane benchmark: the COCO-cardinality rehearsal.
+
+Reference: none — the reference assumes a downloaded COCO and a loader
+fast enough for one GPU.  This tool is the measurement half of the r7
+streaming input plane (docs/DATA.md): every pre-r7 loader/cache/pool
+claim was made on <=400-image sets that fit in HBM, while the reference
+trained 118k-image epochs.  It drives a 10-50k-image, 80-class synthetic
+set (``data/synthetic.py — StreamSyntheticDataset``) through the REAL
+input path end to end and records a BENCH-style JSON with ``--check``
+invariants:
+
+* **shard rig** — N real worker PROCESSES each own a row shard of the
+  topology-invariant streaming plan and consume one full epoch;
+  invariant: the union of decoded (image, flip) identities is the epoch
+  EXACTLY ONCE, and each process decoded ~total/N (the multi-process
+  decode-1/N claim, measured not asserted).
+* **streaming epoch** — one full epoch through StreamLoader → bounded
+  decoded-image cache (budget derived under ``data.ram_ceiling_mb``) →
+  double-buffered host→device staging (``data/staging.py``) → a jitted
+  device consumer; invariants: exactly-once, ZERO lowerings in the
+  timed pass, peak RSS under the configured ceiling, sustained imgs/s
+  against ``--min_rate`` (the 2x PR-5 38.2 imgs/s bar for the full
+  rehearsal), plus a per-stage ms table for the host-bound argument.
+* **eval leg** — the test split through the real eval loader
+  (``TestLoader``), the input half of ``pred_eval``.
+* **small-set control** — a REAL ``train_net`` run (tiny model) with
+  streaming + staging + obs on; invariant: ``data_wait_frac ~ 0`` (the
+  double-buffering claim) and a non-zero stage-overlap counter.
+
+``--smoke`` shrinks every leg to gate scale (``make data-smoke``,
+wired into ``make test-gate``); ``--worker`` is the internal shard-rig
+entry (one process, one shard, ids out to a file).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def _vm_peak_mb() -> float:
+    """Peak RSS (VmHWM) of this process in MiB, from /proc."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+def _vm_rss_mb() -> float:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+def _build(args, shard=None):
+    """(cfg, roidb, loader) for the train split streaming epoch."""
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.data import load_gt_roidb
+    from mx_rcnn_tpu.data.loader import StreamLoader, cache_from_config
+
+    over = ({"dataset__dataset_path": args.dataset_path}
+            if args.dataset_path else {})
+    cfg = generate_config(
+        args.network, args.dataset,
+        dataset__root_path=args.root_path,
+        train__flip=False,  # epoch cardinality = unique images, exactly
+        data__ram_ceiling_mb=args.ram_ceiling_mb,
+        data__streaming=True,
+        default__num_workers=args.num_workers,
+        obs__enabled=False, **over)
+    kw = {"num_images": args.num_images}
+    _, roidb = load_gt_roidb(cfg, training=True, **kw)
+    bh, bw = cfg.bucket.shapes[0]
+    cache = cache_from_config(cfg, n_images=len(roidb),
+                              image_bytes=bh * bw * 3,
+                              batch_bytes=args.batch_images * bh * bw * 3)
+    loader = StreamLoader(roidb, cfg, batch_images=args.batch_images,
+                          shuffle=True, seed=args.seed, cache=cache,
+                          shard=shard)
+    loader.record_decodes()
+    loader.set_epoch(0)
+    return cfg, roidb, loader
+
+
+def run_worker(args) -> int:
+    """Shard-rig worker: consume one epoch of shard (shard_id/num_shards),
+    dump decoded identities + stats to --ids_out."""
+    _, roidb, loader = _build(args, shard=(args.shard_id, args.num_shards))
+    t0 = time.perf_counter()
+    batches = sum(1 for _ in loader)
+    wall = time.perf_counter() - t0
+    with open(args.ids_out, "w") as f:
+        json.dump({"shard_id": args.shard_id,
+                   "num_shards": args.num_shards,
+                   "images_decoded": loader.images_decoded,
+                   "batches": batches,
+                   "wall_s": round(wall, 3),
+                   "peak_rss_mb": round(_vm_peak_mb(), 1),
+                   "ids": sorted(loader.decoded_ids)}, f)
+    return 0
+
+
+def _spawn_shard_rig(args):
+    """N real processes, each owning one shard of the same epoch plan."""
+    outs = []
+    procs = []
+    tmp = tempfile.mkdtemp(prefix="data_bench_rig_")
+    for s in range(args.num_shards):
+        ids_out = os.path.join(tmp, f"shard{s}.json")
+        outs.append(ids_out)
+        cmd = [sys.executable, "-m", "mx_rcnn_tpu.tools.data_bench",
+               "--worker", "--shard_id", str(s),
+               "--num_shards", str(args.num_shards),
+               "--ids_out", ids_out,
+               "--dataset", args.dataset, "--network", args.network,
+               "--root_path", args.root_path,
+               *(["--dataset_path", args.dataset_path]
+                 if args.dataset_path else []),
+               "--num_images", str(args.num_images),
+               "--batch_images", str(args.batch_images),
+               "--num_workers", str(args.num_workers),
+               "--ram_ceiling_mb", str(args.ram_ceiling_mb),
+               "--seed", str(args.seed)]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        procs.append(subprocess.Popen(cmd, env=env,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT, text=True))
+    logs = [p.communicate()[0] for p in procs]
+    for p, log in zip(procs, logs):
+        if p.returncode:
+            raise RuntimeError(f"shard worker failed rc={p.returncode}:\n"
+                               + log[-2000:])
+    return [json.load(open(o)) for o in outs]
+
+
+def _expected_epoch_ids(args):
+    """The exactly-once reference: every (index, flipped=False) of the
+    train split that the epoch's full batches cover."""
+    _, roidb, loader = _build(args)
+    plan = loader._plan(0, args.batch_images)
+    ids = sorted((int(roidb[i].get("index", -1)), False)
+                 for _, idx in plan for i in idx)
+    return ids, len(roidb)
+
+
+def run_stream_epoch(args, record, expected):
+    """One full epoch: StreamLoader → bounded cache → staging → jitted
+    device consumer.  Timed pass must lower ZERO new programs.
+    ``expected`` is the precomputed exactly-once reference
+    (:func:`_expected_epoch_ids`)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mx_rcnn_tpu.data.staging import DeviceStager
+    from mx_rcnn_tpu.obs.metrics import LoweringCounter, registry
+
+    cfg, roidb, loader = _build(args)
+    rec = registry()
+    loader._rec = rec  # decode/assemble stage gauges without obs config
+
+    @jax.jit
+    def consume(images, gt_boxes):
+        # touch every input byte on device: the staging path's device
+        # consumer (stands in for the train step; the REAL train step
+        # runs in the control leg and the elastic/ft suites)
+        return (jnp.sum(images, dtype=jnp.int32)
+                + jnp.sum(gt_boxes).astype(jnp.int32))
+
+    # warm the one program outside the timed pass (one bucket, one shape)
+    bh, bw = cfg.bucket.shapes[0]
+    import numpy as np
+    consume(jnp.zeros((args.batch_images, bh, bw, 3), jnp.uint8),
+            jnp.zeros((args.batch_images,
+                       cfg.train.max_gt_boxes, 4))).block_until_ready()
+
+    stager = DeviceStager(iter(loader), jax.device_put, depth=2, rec=rec)
+    out = None
+    n_img = 0
+    waits = []
+    peak_rss = 0.0
+    t0 = time.perf_counter()
+    with LoweringCounter() as lc:
+        it = iter(stager)
+        while True:
+            tw = time.perf_counter()
+            batch = next(it, None)
+            waits.append(time.perf_counter() - tw)
+            if batch is None:
+                break
+            out = consume(batch.images, batch.gt_boxes)
+            if args.step_ms:
+                time.sleep(args.step_ms / 1e3)  # simulated device step
+            n_img += batch.images.shape[0]
+            if n_img % 512 < args.batch_images:
+                peak_rss = max(peak_rss, _vm_rss_mb())
+        if out is None:
+            raise SystemExit(
+                f"streaming epoch yielded ZERO batches — num_images="
+                f"{args.num_images} is below batch_images="
+                f"{args.batch_images} per bucket")
+        sink = int(out)
+    wall = time.perf_counter() - t0
+    stager.close()
+    peak_rss = max(peak_rss, _vm_rss_mb())
+    ids = sorted(loader.decoded_ids)
+    hits = rec.counter("loader.stage_hits")
+    misses = rec.counter("loader.stage_misses")
+    decode_h = rec.hist("loader.decode_ms")
+    assemble_h = rec.hist("loader.assemble_ms")
+    record["stream_epoch"] = {
+        "images": n_img,
+        "roidb_images": len(roidb),
+        "wall_s": round(wall, 3),
+        "imgs_per_sec": round(n_img / wall, 2),
+        "exactly_once": ids == expected,
+        "timed_pass_lowerings": lc.n,
+        "peak_rss_mb": round(max(peak_rss, _vm_peak_mb()), 1),
+        "vm_hwm_mb": round(_vm_peak_mb(), 1),
+        "ram_ceiling_mb": args.ram_ceiling_mb,
+        "cache": ({"hits": loader.cache.hits, "misses": loader.cache.misses,
+                   "ram_budget_mb": loader.cache.ram_bytes >> 20}
+                  if loader.cache is not None else None),
+        "stage": {
+            "hits": hits, "misses": misses,
+            "hit_rate": round(hits / max(hits + misses, 1), 3),
+            "consumer_wait_ms_total": round(sum(waits) * 1e3, 1),
+            "decode_ms_per_batch_p50": (
+                round(decode_h.percentile(50), 3) if decode_h else None),
+            "assemble_ms_per_batch_p50": (
+                round(assemble_h.percentile(50), 3) if assemble_h else None),
+        },
+        "simulated_step_ms": args.step_ms,
+        "consumer_checksum": sink,
+    }
+
+
+def run_eval_leg(args, record):
+    """The test split through the real eval input path (TestLoader)."""
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.data import load_gt_roidb
+    from mx_rcnn_tpu.data.loader import TestLoader
+
+    over = ({"dataset__dataset_path": args.dataset_path}
+            if args.dataset_path else {})
+    cfg = generate_config(args.network, args.dataset,
+                          dataset__root_path=args.root_path,
+                          data__ram_ceiling_mb=args.ram_ceiling_mb, **over)
+    _, roidb = load_gt_roidb(cfg, training=False,
+                             num_images=args.test_images)
+    loader = TestLoader(roidb, cfg, batch_images=args.batch_images,
+                        num_workers=args.num_workers)
+    t0 = time.perf_counter()
+    n = sum(b.images.shape[0] for b, _, _ in loader)
+    wall = time.perf_counter() - t0
+    record["eval_leg"] = {
+        "images": n, "expected": len(roidb),
+        "wall_s": round(wall, 3),
+        "imgs_per_sec": round(n / wall, 2),
+        "decoded": loader.images_decoded,
+    }
+
+
+def run_control(args, record):
+    """Small-set control: REAL training (tiny model) with streaming +
+    staging + obs — the data_wait_frac ~ 0 claim, measured on the path
+    production uses."""
+    import tempfile as tf
+
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.obs.metrics import registry
+    from mx_rcnn_tpu.tools.train import train_net
+
+    registry().reset("train.")
+    registry().reset("loader.")
+    root = tf.mkdtemp(prefix="data_bench_ctrl_")
+    cfg = generate_config(
+        "tiny", "synthetic",
+        dataset__root_path=root,
+        dataset__dataset_path=os.path.join(root, "synthetic"),
+        train__flip=False, train__rpn_pre_nms_top_n=256,
+        train__rpn_post_nms_top_n=64, train__max_gt_boxes=8,
+        bucket__scale=128, bucket__max_size=160,
+        bucket__shapes=((128, 160), (160, 128)),
+        train__batch_images=2,
+        data__streaming=True, data__staging=True, obs__enabled=True)
+    train_net(cfg, prefix=os.path.join(root, "model", "e2e"),
+              end_epoch=args.control_epochs, frequent=1000, seed=0,
+              dataset_kw={"num_images": args.control_images,
+                          "image_size": (128, 160), "max_objects": 3})
+    rec = registry()
+    wait_h = rec.hist("train.data_wait_ms")
+    step_h = rec.hist("train.step_ms")
+    frac_h = rec.hist("train.data_wait_frac_pct")
+    wait_p50 = wait_h.percentile(50) if wait_h else None
+    step_p50 = step_h.percentile(50) if step_h else None
+    # p50 of the PER-STEP wait/step fraction (fit records it as a
+    # distribution) — NOT a ratio of independent percentiles, which a
+    # bimodal run could game
+    frac_p50 = (frac_h.percentile(50) / 100.0 if frac_h else None)
+    record["control"] = {
+        "steps": rec.counter("train.steps"),
+        "epochs": args.control_epochs,
+        "images": args.control_images,
+        "data_wait_ms_p50": round(wait_p50, 3) if wait_p50 else wait_p50,
+        "step_ms_p50": round(step_p50, 3) if step_p50 else step_p50,
+        "data_wait_frac_p50": (round(frac_p50, 4)
+                               if frac_p50 is not None else None),
+        "stage_hits": rec.counter("loader.stage_hits"),
+        "staged_batches": rec.counter("loader.staged_batches"),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Streaming input-plane benchmark (docs/DATA.md)")
+    p.add_argument("--dataset", default="synthetic_stream",
+                   choices=["synthetic", "synthetic_hard",
+                            "synthetic_stream"])
+    p.add_argument("--network", default="tiny")
+    p.add_argument("--root_path", default="data")
+    p.add_argument("--dataset_path", default=None,
+                   help="dataset directory (default: the preset path; "
+                        "--smoke defaults to a sibling *_smoke dir so "
+                        "gate runs never invalidate the rehearsal set's "
+                        "PNG cache stamp)")
+    p.add_argument("--num_images", type=int, default=10_000)
+    p.add_argument("--test_images", type=int, default=1_000)
+    p.add_argument("--batch_images", type=int, default=2)
+    p.add_argument("--num_workers", type=int, default=2)
+    p.add_argument("--num_shards", type=int, default=2,
+                   help="worker PROCESSES in the shard rig")
+    p.add_argument("--ram_ceiling_mb", type=int, default=4096)
+    p.add_argument("--min_rate", type=float, default=0.0,
+                   help="imgs/s floor for the streaming epoch under "
+                        "--check (the rehearsal uses 76.4 = 2x the PR-5 "
+                        "38.2 single-core baseline)")
+    p.add_argument("--step_ms", type=float, default=0.0,
+                   help="simulated device step per batch in the "
+                        "streaming epoch (0 = pure input-plane rate)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--control_images", type=int, default=64)
+    p.add_argument("--control_epochs", type=int, default=2)
+    p.add_argument("--skip_control", action="store_true")
+    p.add_argument("--skip_rig", action="store_true")
+    p.add_argument("--smoke", action="store_true",
+                   help="gate scale: tiny set, every invariant")
+    p.add_argument("--check", action="store_true")
+    p.add_argument("--out", default=None, help="write the record here too")
+    # internal worker mode
+    p.add_argument("--worker", action="store_true")
+    p.add_argument("--shard_id", type=int, default=0)
+    p.add_argument("--ids_out", default=None)
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        args.num_images = min(args.num_images, 240)
+        args.test_images = min(args.test_images, 60)
+        args.control_epochs = min(args.control_epochs, 2)
+        args.ram_ceiling_mb = min(args.ram_ceiling_mb, 3072)
+        if args.dataset_path is None:
+            # own directory: a 240-image smoke regenerating inside the
+            # 10k rehearsal directory would invalidate its spec stamp
+            # and force a full re-materialization on the next rehearsal
+            args.dataset_path = os.path.join(
+                args.root_path, f"{args.dataset}_smoke")
+
+    if args.worker:
+        return run_worker(args)
+
+    record = {"metric": "stream_input_plane_r7",
+              "dataset": args.dataset,
+              "num_images": args.num_images,
+              "batch_images": args.batch_images,
+              "smoke": bool(args.smoke)}
+    t_all = time.perf_counter()
+
+    # materialize the dataset (and the epoch-plan reference) in the
+    # PARENT first: rig workers spawning concurrently must find the PNGs
+    # already on disk, not race each other writing them
+    expected, _ = _expected_epoch_ids(args)
+
+    if not args.skip_rig:
+        workers = _spawn_shard_rig(args)
+        union = sorted(
+            tuple(i) for w in workers for i in w["ids"])
+        counts = [w["images_decoded"] for w in workers]
+        total = sum(counts)
+        wall = max(w["wall_s"] for w in workers)
+        record["shard_rig"] = {
+            "processes": args.num_shards,
+            "per_process_decoded": counts,
+            "total_decoded": total,
+            "expected_images": len(expected),
+            "union_exactly_once": union == expected,
+            "per_process_share": [round(c / max(total, 1), 3)
+                                  for c in counts],
+            "wall_s": wall,
+            "aggregate_imgs_per_sec": round(total / wall, 2),
+            "per_process_peak_rss_mb": [w["peak_rss_mb"] for w in workers],
+        }
+
+    run_stream_epoch(args, record, expected)
+    run_eval_leg(args, record)
+    if not args.skip_control:
+        run_control(args, record)
+    record["wall_s_total"] = round(time.perf_counter() - t_all, 1)
+
+    checks = {}
+    if "shard_rig" in record:
+        r = record["shard_rig"]
+        checks["rig_union_exactly_once"] = r["union_exactly_once"]
+        n = r["processes"]
+        checks["rig_decode_split"] = all(
+            abs(s - 1.0 / n) < 0.02 for s in r["per_process_share"])
+    se = record["stream_epoch"]
+    checks["stream_exactly_once"] = se["exactly_once"]
+    checks["zero_timed_lowerings"] = se["timed_pass_lowerings"] == 0
+    if args.ram_ceiling_mb > 0:  # 0 = unlimited (no ceiling to enforce)
+        checks["rss_under_ceiling"] = (se["peak_rss_mb"]
+                                       <= args.ram_ceiling_mb)
+    checks["stage_overlap_nonzero"] = se["stage"]["hits"] > 0
+    if args.min_rate > 0:
+        checks["rate_floor"] = se["imgs_per_sec"] >= args.min_rate
+    checks["eval_complete"] = (record["eval_leg"]["images"]
+                               == record["eval_leg"]["expected"])
+    if "control" in record:
+        c = record["control"]
+        frac = c["data_wait_frac_p50"]
+        checks["control_data_wait_near_zero"] = (frac is not None
+                                                 and frac < 0.15)
+        checks["control_stage_overlap_nonzero"] = c["stage_hits"] > 0
+    record["checks"] = checks
+    record["ok"] = all(checks.values())
+
+    out = json.dumps(record, indent=1)
+    print(out, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    if args.check and not record["ok"]:
+        failed = [k for k, v in checks.items() if not v]
+        print(f"CHECK FAILED: {failed}", file=sys.stderr)
+        return 1
+    if args.check:
+        print("CHECK OK: " + ", ".join(checks), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

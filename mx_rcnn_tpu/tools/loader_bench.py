@@ -43,7 +43,7 @@ def main(argv=None):
         description="Benchmark the host input pipeline configurations")
     p.add_argument("--dataset", default="synthetic_hard",
                    choices=["PascalVOC", "coco", "synthetic",
-                            "synthetic_hard"])
+                            "synthetic_hard", "synthetic_stream"])
     p.add_argument("--network", default="resnet101",
                    choices=["vgg", "resnet50", "resnet101", "tiny"])
     p.add_argument("--root_path", default="data")

@@ -70,7 +70,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--network", default="resnet101",
                    choices=["vgg", "resnet50", "resnet101", "tiny"])
     p.add_argument("--dataset", default="PascalVOC",
-                   choices=["PascalVOC", "coco", "synthetic", "synthetic_hard"])
+                   choices=["PascalVOC", "coco", "synthetic",
+                            "synthetic_hard", "synthetic_stream"])
     p.add_argument("--image_set", default=None,
                    help="defaults to the dataset's test_image_set")
     p.add_argument("--root_path", default=None)

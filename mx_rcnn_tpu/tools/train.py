@@ -171,23 +171,58 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
 
     grad_accum = max(int(grad_accum), 1)
     n_total = cfg.train.batch_images * num_devices
-    decode_pool = decode_pool_from_config(cfg)
+    # cache budgets derive from the bounded streaming window, not the
+    # raw config number (loader.py — stream_cache_budget; logged once)
+    bh0, bw0 = cfg.bucket.shapes[0]
+    image_bytes = bh0 * bw0 * 3
+    batch_bytes = n_total * image_bytes
+    decode_pool = decode_pool_from_config(cfg, n_images=len(roidb),
+                                          image_bytes=image_bytes,
+                                          batch_bytes=batch_bytes)
     # with a decode pool the cache lives IN the workers (loader.py —
     # decode_pool_from_config splits the RAM budget across them); a
     # parent-side cache would be dead weight the pool path never consults
-    cache = None if decode_pool is not None else cache_from_config(cfg)
+    cache = (None if decode_pool is not None
+             else cache_from_config(cfg, n_images=len(roidb),
+                                    image_bytes=image_bytes,
+                                    batch_bytes=batch_bytes))
+    # loader-shard ownership (docs/DATA.md): each process of a
+    # multi-process world decodes only its row slice of every batch
+    # (1/N of the epoch).  ONLY the process topology shards here —
+    # explicit shard ownership is a bench-rig concept
+    # (tools/data_bench.py --shard_id/--num_shards), where sibling
+    # processes consume the other shards; sharding a lone training
+    # process would silently train on 1/N of every batch.
+    shard = None
+    if multiproc and jax.process_count() > 1:
+        shard = (jax.process_index(), jax.process_count())
+    loader_kw = dict(batch_images=n_total, shuffle=cfg.train.shuffle,
+                     seed=seed, cache=cache, decode_pool=decode_pool,
+                     shard=shard)
     if mode == "rcnn":
         from mx_rcnn_tpu.data.loader import ROIIter
 
         if proposals is None:
             raise ValueError("mode='rcnn' requires precomputed proposals")
-        loader = ROIIter(roidb, cfg, proposals, batch_images=n_total,
-                         shuffle=cfg.train.shuffle, seed=seed, cache=cache,
-                         decode_pool=decode_pool)
+        if cfg.data.streaming:
+            logger.warning(
+                "data.streaming=true is not implemented for mode='rcnn' "
+                "(proposal-fed ROIIter keeps the classic plan) — "
+                "mid-epoch resume across a topology change falls back "
+                "to same-topology skip semantics")
+        loader = ROIIter(roidb, cfg, proposals, **loader_kw)
+    elif cfg.data.streaming:
+        # the topology-invariant streaming plan: shard unions and
+        # mid-epoch cursors stay exactly-once across resizes
+        from mx_rcnn_tpu.data.loader import StreamLoader
+
+        loader = StreamLoader(roidb, cfg, **loader_kw)
     else:
-        loader = AnchorLoader(roidb, cfg, batch_images=n_total,
-                              shuffle=cfg.train.shuffle, seed=seed,
-                              cache=cache, decode_pool=decode_pool)
+        loader = AnchorLoader(roidb, cfg, **loader_kw)
+    if shard is not None:
+        logger.info("loader shard %d/%d: this process decodes %d of %d "
+                    "rows per batch", shard[0], shard[1],
+                    n_total // shard[1], n_total)
     # OPTIMIZER steps per epoch (== loader batches unless accumulating);
     # the LR schedule and the step↔epoch resume math count these
     steps_per_epoch = max(len(loader) // grad_accum, 1)
@@ -215,6 +250,7 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
         p, s = load_param(*init_from)
         state = state._replace(params=p, batch_stats=s)
         logger.info("initialized params from %s epoch %d", *init_from)
+    data_cursor = None
     if resume == "auto" and begin_epoch == 0:
         # integrity-verified resume (ft/integrity.py): scan candidates
         # newest→oldest by manifest step, verify checksums, fall back past
@@ -265,6 +301,37 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
                 logger.info("resumed mid-epoch from verified %s "
                             "(step %d → epoch %d)", ref.path, step,
                             begin_epoch)
+                # data-shard cursor (PR 6 recorded it, r7 consumes it):
+                # the writing run's loader batch size lets a streaming
+                # loader replay THAT run's plan and continue the epoch
+                # exactly-once — even when this run's topology (and so
+                # its batch size) differs (core/fit.py — resume_at)
+                topo = ref.manifest.get("topology") or {}
+                cur = ref.manifest.get("data_cursor") or {}
+                if topo.get("global_batch") and topo.get("grad_accum"):
+                    old_bi = (int(topo["global_batch"])
+                              // int(topo["grad_accum"]))
+                    # images consumed IN THIS EPOCH, computed from the
+                    # authoritative state.step under the topology that
+                    # WROTE the checkpoint — correct even when the
+                    # effective global batch changed across the resume
+                    # (ft.allow_resize_resume), where the new-topology
+                    # skip math would reposition the loader wrongly
+                    images = ((step % steps_per_epoch)
+                              * int(topo["global_batch"]))
+                    data_cursor = {"loader_batch_images": old_bi,
+                                   "images_consumed_in_epoch": images}
+                    want = cur.get("batches_consumed")
+                    if want is not None and int(want) * old_bi != images:
+                        # manifest/state disagreement about how much
+                        # data was consumed — the state is what training
+                        # resumes from, so it wins; say so loudly
+                        logger.warning(
+                            "resume: manifest data_cursor says %s "
+                            "batches x %d images consumed but "
+                            "state.step implies %d images — using the "
+                            "step-derived position", want, old_bi,
+                            images)
             else:
                 begin_epoch = ref.epoch
                 state = restore_state(state, prefix, begin_epoch)
@@ -318,7 +385,8 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
                     device_cache=device_cache, step_callback=step_callback,
                     run_record=run_record,
                     epoch_end_callback=epoch_end_callback,
-                    grad_accum=grad_accum, multiproc=multiproc)
+                    grad_accum=grad_accum, multiproc=multiproc,
+                    data_cursor=data_cursor)
     finally:
         if decode_pool is not None:
             decode_pool.close()
@@ -381,7 +449,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--network", default="resnet101",
                    choices=["vgg", "resnet50", "resnet101", "tiny"])
     p.add_argument("--dataset", default="PascalVOC",
-                   choices=["PascalVOC", "coco", "synthetic", "synthetic_hard"])
+                   choices=["PascalVOC", "coco", "synthetic",
+                            "synthetic_hard", "synthetic_stream"])
     p.add_argument("--image_set", default=None,
                    help="e.g. 2007_trainval or 2007_trainval+2012_trainval")
     p.add_argument("--root_path", default=None)

@@ -540,7 +540,7 @@ def test_decode_pool_small_cache_budget_clamped(monkeypatch, caplog):
     with caplog.at_level(logging.WARNING, logger="mx_rcnn_tpu"):
         loader_mod.decode_pool_from_config(cfg)
     assert built["ram_bytes"] == 1 << 20
-    assert "image_cache_mb=4" in caplog.text
+    assert "cache budget 4 MB" in caplog.text
     assert "decode_procs=8" in caplog.text
     # a healthy budget still splits undisturbed, without the warning
     built.clear()
