@@ -14,7 +14,7 @@ NATIVE_LIB := $(NATIVE_DIR)/libmxrcnn_native.so
 NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 
 .PHONY: all native lint test test-all test-gate serve-smoke ft-smoke \
-	obs-smoke perf-smoke elastic-smoke data-smoke clean
+	obs-smoke perf-smoke elastic-smoke data-smoke fleet-smoke clean
 
 all: native
 
@@ -93,6 +93,21 @@ data-smoke:
 	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.data_bench \
 		--smoke --check --root_path data
 
+# fleet smoke (docs/SERVING.md "Fleet tier"): the gate-scale FLEET_r08
+# protocol on the tiny model — exports every serving program to an AOT
+# store (bit-equality verified against the live trace), cold-joins one
+# replica trace-warm vs export-warm in FRESH processes (export-warm must
+# land under 50% of trace-warm; the full bench holds the 10% bar on
+# ResNet-50), runs a 2-replica export-warm fleet under a mixed-bucket
+# closed-loop burst (zero lost, ZERO post-join recompiles), the
+# stub-device router-scaling legs (>= 1.8x at 2 replicas), an
+# overdriven shed leg, and a kill-mid-burst leg (replica killed under
+# load: zero lost fleet-wide, stranded work rerouted, replica
+# relaunched + rejoined).  ~2 min warm.
+fleet-smoke:
+	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.loadgen \
+		--fleet_smoke --check
+
 # elastic smoke (docs/FT.md "Elasticity"): a 2-process jax.distributed
 # CPU world loses one process to SIGTERM mid-epoch, shrinks onto the
 # survivor's device set (grad-accum rescaled so the global batch stays
@@ -111,10 +126,11 @@ elastic-smoke:
 # instead of after 30 minutes of training; serve-smoke next (~30 s),
 # then the perf-tooling smoke (~1 min), the observability smoke
 # (~1 min), the streaming input-plane smoke (data-smoke, ~30 s), the
-# 2-kill crash loop (ft-smoke, ~2 min) and the elastic shrink/grow
-# storm (elastic-smoke, ~3 min)
-test-gate: lint serve-smoke perf-smoke obs-smoke data-smoke ft-smoke \
-		elastic-smoke
+# serving-fleet smoke (fleet-smoke, ~2 min), the 2-kill crash loop
+# (ft-smoke, ~2 min) and the elastic shrink/grow storm
+# (elastic-smoke, ~3 min)
+test-gate: lint serve-smoke perf-smoke obs-smoke data-smoke fleet-smoke \
+		ft-smoke elastic-smoke
 	python -m pytest tests/ -x -q -m "gate"
 
 clean:

@@ -321,6 +321,45 @@ class ServeConfig:
 
 
 @dataclass(frozen=True)
+class FleetConfig:
+    """TPU addition (no reference equivalent): policy knobs for the
+    ``mx_rcnn_tpu/serve/fleet.py`` serving fleet — N replica engines over
+    device subsets behind a join-shortest-queue router, warmed from
+    AOT-exported programs (``serve/export.py``) so a cold replica joins
+    in seconds instead of paying trace+compile (docs/SERVING.md "Fleet
+    tier").
+
+    Same 3-level precedence as every section (hardcoded defaults <
+    presets < ``--set fleet__field=value`` CLI overrides).
+    """
+
+    # replica engines in the fleet (each one full ServingEngine over its
+    # own Predictor; tools/fleet.py serve --replicas overrides)
+    replicas: int = 1
+    # AOT export store directory ("" = trace-warm: every replica pays
+    # the classic trace+compile warmup).  Written by
+    # ``tools/fleet.py export``; holds serialized per-bucket programs +
+    # manifest + the bundled XLA persistent cache.
+    export_dir: str = ""
+    # devices per replica (0 = divide jax.devices() evenly; replicas
+    # beyond the device supply share the remainder round-robin).  A
+    # subset of size > 1 becomes that replica's 1-D data mesh — the
+    # mesh-sharded Predictor math from core/tester.py, per replica.
+    devices_per_replica: int = 0
+    # replica health monitor cadence: dead/unhealthy replicas are
+    # ejected from the routing set and (when ``relaunch``) rebuilt via
+    # the ft/supervisor.py RestartPolicy backoff schedule
+    health_interval_s: float = 1.0
+    # how many times the router re-dispatches a request whose replica
+    # died before serving it (0 = fail straight to the client); reroutes
+    # never extend the request's deadline
+    reroute_retries: int = 1
+    # relaunch crashed replicas (RestartPolicy paces retries and turns
+    # repeated identical failures into a crash-loop verdict)
+    relaunch: bool = True
+
+
+@dataclass(frozen=True)
 class FTConfig:
     """TPU addition (no reference equivalent — the reference dies on
     preemption and restarts at the last epoch boundary): policy knobs for
@@ -354,6 +393,14 @@ class FTConfig:
     # the error to a WARNING; the elastic controller (ft/elastic.py) sets
     # it for its own supervised restores, where the resize is the point.
     allow_resize_resume: bool = False
+    # persistent XLA compilation cache directory ("" = off).  Wired at
+    # CLI startup (tools/train.py, tools/serve.py, tools/fleet.py —
+    # ``serve/export.py — enable_compile_cache``) into BOTH the live
+    # process config and the child environment, so elastic relaunches
+    # (EXIT_RESIZE/EXIT_PEER_FAILURE supervisor restarts) skip XLA
+    # re-compilation and pay tracing only — the ROADMAP item 5
+    # recovery-time lever (measured deltas: docs/FT.md "Recovery time").
+    compile_cache_dir: str = ""
 
 
 @dataclass(frozen=True)
@@ -444,6 +491,7 @@ class Config:
     bucket: BucketConfig = field(default_factory=BucketConfig)
     data: DataConfig = field(default_factory=DataConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
     ft: FTConfig = field(default_factory=FTConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     elastic: ElasticConfig = field(default_factory=ElasticConfig)

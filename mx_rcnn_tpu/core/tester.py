@@ -67,10 +67,7 @@ class Predictor:
                     np.concatenate([a, np.full((pad,) + a.shape[1:], fill,
                                                a.dtype)])
                     for a, fill in zip(arrays, pad_fills))
-        # keyed by mode AND shape AND dtype: uint8 raw batches and fp32
-        # host-normalized batches compile to different programs
-        shape = (kind,) + tuple(
-            (tuple(a.shape), np.dtype(a.dtype).name) for a in arrays)
+        shape = self.program_key(kind, arrays)
         if shape not in self._fns:
             self._fns[shape] = make_fn()
         if self.mesh is not None:
@@ -86,6 +83,29 @@ class Predictor:
         if self.mesh is not None and out[0].shape[0] != n:
             out = tuple(o[:n] for o in out)
         return out
+
+    @staticmethod
+    def program_key(kind: str, arrays) -> Tuple:
+        """The per-(mode, shape, dtype) program-cache key ``_forward``
+        caches jitted functions under — keyed by mode AND shape AND
+        dtype: uint8 raw batches and fp32 host-normalized batches compile
+        to different programs.  Public so the AOT export store
+        (``serve/export.py``) can address the same slots."""
+        return (kind,) + tuple(
+            (tuple(a.shape), np.dtype(a.dtype).name) for a in arrays)
+
+    def install_program(self, key: Tuple, fn) -> None:
+        """Pre-populate one program-cache slot with an ahead-of-time
+        program (a deserialized ``jax.export`` call wrapped in
+        ``jax.jit`` — ``serve/export.py — ExportStore.load``).  The slot
+        is called exactly like a live-traced one:
+        ``fn(variables, *arrays)``.  Installing over an existing slot is
+        refused — silently shadowing a live-traced program would make
+        "which program served this?" unanswerable."""
+        if key in self._fns:
+            raise ValueError(f"program slot {key!r} already resident — "
+                             "install exports before the first forward")
+        self._fns[key] = fn
 
     def raw(self, images: np.ndarray, im_info: np.ndarray):
         """Forward pass returning DEVICE arrays (no host sync) — the eval

@@ -96,6 +96,17 @@ def choose_bucket(h: int, w: int, buckets: Sequence[Tuple[int, int]]
     return max(same or buckets, key=lambda b: b[0] * b[1])
 
 
+def estimate_bucket(h: int, w: int, scale: int, max_size: int,
+                    buckets: Sequence[Tuple[int, int]]) -> Tuple[int, int]:
+    """Dims-only bucket estimate (no pixel work): the bucket an (h, w)
+    image serves in after ``resize_keep_ratio``.  One helper shared by
+    the serving engine's admission pre-check and the fleet router's
+    lane choice, so the two can never disagree on where a request
+    queues."""
+    s = compute_scale(h, w, scale, max_size)
+    return choose_bucket(int(round(h * s)), int(round(w * s)), buckets)
+
+
 def load_resized_uint8(
     path: str,
     flipped: bool,
@@ -160,6 +171,18 @@ def resize_to_bucket(img: np.ndarray, pixel_means: Sequence[float], scale: int,
     resized, im_scale = resize_keep_ratio(np.asarray(img), scale, max_size)
     h, w = resized.shape[:2]
     bucket = choose_bucket(h, w, buckets)
+    fit = bucket_fit(h, w, bucket)
+    if fit != 1.0:  # bucket smaller than resize target: shrink to fit,
+        # same step as load_resized_uint8 (choose_bucket's contract)
+        new_w, new_h = int(w * fit), int(h * fit)
+        if _HAS_CV2:
+            resized = cv2.resize(resized, (new_w, new_h))
+        else:  # pragma: no cover
+            resized = np.asarray(
+                Image.fromarray(np.ascontiguousarray(resized))
+                .resize((new_w, new_h)))
+        im_scale *= fit
+        h, w = resized.shape[:2]
     bh, bw = bucket
     out = np.zeros((bh, bw, 3), dtype=np.float32)
     np.subtract(resized, np.asarray(pixel_means, dtype=np.float32),

@@ -261,6 +261,18 @@ class ServeMetrics:
             self.registry._hists.setdefault(p + "model_ms",
                                             Histogram()).record(model_ms)
 
+    def in_flight(self) -> int:
+        """Admitted-but-not-terminal request count — O(1) under one lock
+        (five counter reads), cheap enough for the fleet router's
+        per-request join-shortest-queue decision (``serve/fleet.py``),
+        where a full :meth:`snapshot` per routing choice would not be."""
+        p = self.PREFIX
+        with self.registry.lock:
+            c = self.registry._counters
+            return c.get(p + "submitted", 0) - (
+                c.get(p + "served", 0) + c.get(p + "shed", 0)
+                + c.get(p + "expired", 0) + c.get(p + "failed", 0))
+
     def snapshot(self) -> Dict:
         """One consistent dict: counters, percentiles, occupancy — the
         serving ``/metrics`` response body and the loadgen record source.

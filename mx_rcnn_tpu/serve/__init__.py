@@ -7,14 +7,23 @@ Layers, bottom-up:
 * ``metrics.py`` — counters + latency histograms + the recompile guard;
 * ``engine.py``  — per-bucket dynamic micro-batching over ``Predictor``,
   sharing the eval path's jitted postprocess bit for bit;
-* ``server.py``  — stdlib JSON/HTTP front end (/detect /healthz /metrics).
+* ``server.py``  — stdlib JSON/HTTP front end (/detect /healthz /metrics);
+* ``export.py``  — AOT-exported programs + persistent compile cache: a
+  cold replica joins in seconds instead of paying trace+compile;
+* ``fleet.py``   — the fleet tier: N replica engines over device subsets
+  behind a join-shortest-queue router with eject/relaunch
+  (docs/SERVING.md "Fleet tier").
 
 Entry points: ``python -m mx_rcnn_tpu.tools.serve`` (checkpoint → warmed
-HTTP service) and ``python -m mx_rcnn_tpu.tools.loadgen`` (closed/open
-loop load generation + BENCH-style JSON).
+HTTP service), ``python -m mx_rcnn_tpu.tools.fleet`` (export store +
+fleet service), and ``python -m mx_rcnn_tpu.tools.loadgen`` (closed/open
+loop + fleet load generation, BENCH-style JSON).
 """
 
 from mx_rcnn_tpu.serve.engine import ServingEngine  # noqa: F401
+from mx_rcnn_tpu.serve.export import ExportStore  # noqa: F401
+from mx_rcnn_tpu.serve.fleet import (FleetRouter, ReplicaManager,  # noqa: F401
+                                     build_fleet)
 from mx_rcnn_tpu.serve.metrics import (Histogram, LoweringCounter,  # noqa: F401
                                        ServeMetrics)
 from mx_rcnn_tpu.serve.queue import (BoundedQueue, DeadlineExceeded,  # noqa: F401

@@ -59,7 +59,8 @@ class ServingEngine:
     """
 
     def __init__(self, predictor: Predictor, cfg: Config,
-                 metrics: ServeMetrics = None, start: bool = True):
+                 metrics: ServeMetrics = None, start: bool = True,
+                 run_fn=None):
         s = cfg.serve
         if s.batch_size < 1:
             raise ValueError(f"serve.batch_size must be >= 1, got "
@@ -83,6 +84,17 @@ class ServingEngine:
         self._threads: List[threading.Thread] = []
         self._closed = False
         self._warm_programs = 0
+        self.last_warmup_run_s: List[float] = []
+        # full model-path override: run_fn(images, im_info) -> (boxes_b,
+        # scores_b, keep_b).  The fleet loadgen's router-scaling leg
+        # injects a device-compute simulator here (docs/SERVING.md
+        # "Fleet tier" — the honest 1-core-box scaling rig); tests inject
+        # deterministic fakes.  None = the real Predictor+postprocess.
+        self._run_fn = run_fn
+        # AOT postprocess program (warm_from_export installs it); None =
+        # the live-traced shared _postprocess_batch
+        self._post_fn = None
+        self._export_root = None
         if start:
             self.start()
 
@@ -111,7 +123,7 @@ class ServingEngine:
         ``handle.wait()`` blocks and raises the matching error class.
         ``timeout_ms`` overrides ``cfg.serve.default_timeout_ms``
         (0 = no deadline)."""
-        from mx_rcnn_tpu.data.image import choose_bucket, compute_scale
+        from mx_rcnn_tpu.data.image import estimate_bucket
 
         now = time.monotonic()
         t = (self.cfg.serve.default_timeout_ms if timeout_ms is None
@@ -122,10 +134,9 @@ class ServingEngine:
         # must not pay the resize/pad either (shape math only; the offer
         # below stays the authoritative depth check)
         h, w = img.shape[:2]
-        s = compute_scale(h, w, self.cfg.bucket.scale,
-                          self.cfg.bucket.max_size)
-        rough_bucket = choose_bucket(int(round(h * s)), int(round(w * s)),
-                                     self.buckets)
+        rough_bucket = estimate_bucket(h, w, self.cfg.bucket.scale,
+                                       self.cfg.bucket.max_size,
+                                       self.buckets)
         if self._closed or (len(self.queues[rough_bucket])
                             >= self.queues[rough_bucket].shed_watermark):
             req = ServeRequest(None, None, rough_bucket, deadline, now)
@@ -212,11 +223,20 @@ class ServingEngine:
 
     def _run(self, images: np.ndarray, im_info: np.ndarray
              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Forward + the eval-shared postprocess for one padded batch."""
+        """Forward + the eval-shared postprocess for one padded batch.
+        The AOT path (``warm_from_export``) swaps in the deserialized
+        postprocess program; outputs are pinned bit-equal to this live
+        path at export time, so the swap is invisible to clients."""
         import jax.numpy as jnp
 
+        if self._run_fn is not None:
+            return self._run_fn(images, im_info)
         rois, roi_valid, cls_prob, deltas = self.predictor.raw(images,
                                                                im_info)
+        if self._post_fn is not None:
+            return tuple(map(np.asarray, self._post_fn(
+                rois, roi_valid, cls_prob, deltas, jnp.asarray(im_info),
+                jnp.asarray(im_info[:, 2]), self._stds, self._means)))
         return tuple(map(np.asarray, _postprocess_batch(
             rois, roi_valid, cls_prob, deltas, jnp.asarray(im_info),
             jnp.asarray(im_info[:, 2]), self._stds, self._means,
@@ -290,22 +310,105 @@ class ServingEngine:
         invariant; ``tools/loadgen.py`` and the tests assert it with
         :class:`~mx_rcnn_tpu.serve.metrics.LoweringCounter`).  Returns the
         number of per-bucket forward programs now resident."""
+        self.last_warmup_run_s = []
         for bucket in self.buckets:
             bh, bw = bucket
             n = self.cfg.serve.batch_size
             images = np.zeros((n, bh, bw, 3), np.float32)
             im_info = np.tile(np.array([bh, bw, 1.0], np.float32), (n, 1))
+            t0 = time.perf_counter()
             self._run(images, im_info)
+            # per-bucket first-call wall (trace+compile+execute on a
+            # cold program; pure execute on a resident one) — the
+            # join_bench pairs two warmup passes to split compile
+            # overhead from model execution without cross-minute drift
+            self.last_warmup_run_s.append(time.perf_counter() - t0)
         self._warm_programs = len(self.predictor._fns)
         logger.info("serve warmup: %d bucket program(s) + shared "
                     "postprocess compiled", self._warm_programs)
         return self._warm_programs
+
+    def warm_from_export(self, store) -> Dict:
+        """AOT warm start (docs/SERVING.md "Fleet tier"): install every
+        per-bucket forward program + the shared postprocess from an
+        :class:`~mx_rcnn_tpu.serve.export.ExportStore` into the
+        Predictor's program cache, then run one dummy batch per bucket —
+        the XLA compile that run triggers is a persistent-cache READ
+        when the store's bundled cache is armed, so the replica is
+        serving in seconds with ZERO tracing of the model.  The store's
+        manifest must match this process's config (``store.check`` ran
+        by the caller or here).  Returns join stats for the fleet
+        manager's join-time gauges."""
+        from mx_rcnn_tpu.serve.export import SERVE_POST, serve_fwd_name
+
+        t0 = time.monotonic()
+        store.check(self.cfg)
+        n = self.cfg.serve.batch_size
+        for bucket in self.buckets:
+            bh, bw = bucket
+            key = self.predictor.program_key(
+                "rpn", (np.zeros((n, bh, bw, 3), np.float32),
+                        np.zeros((n, 3), np.float32)))
+            fwd = store.load(serve_fwd_name(bucket, n))
+            self.predictor.install_program(key, fwd)
+        self._post_fn = store.load(SERVE_POST)
+        self._export_root = store.root
+        t_load = time.monotonic() - t0
+        warm = self.warmup()
+        return {"programs": warm, "load_s": round(t_load, 3),
+                "total_s": round(time.monotonic() - t0, 3),
+                "export_root": store.root}
 
     def program_count(self) -> int:
         """Resident per-bucket forward programs (the Predictor's
         per-(mode, shape, dtype) jit cache) — growth after warmup means a
         recompile leak."""
         return len(self.predictor._fns)
+
+    # ------------------------------------------------------------------
+    # fleet surface (serve/fleet.py)
+    # ------------------------------------------------------------------
+
+    def depth(self) -> int:
+        """In-flight requests (admitted, not yet terminal) — the router's
+        join-shortest-queue signal.  Counts queued AND dispatched work,
+        so a replica mid-batch reads busier than an idle one with equal
+        queues."""
+        return self.metrics.in_flight()
+
+    def bucket_depth(self, bucket: Tuple[int, int]) -> int:
+        """Queued (not yet dispatched) requests in one bucket lane — the
+        router's batch-packing signal.  Total :meth:`depth` alone cannot
+        see per-bucket imbalance: an engine can read lightly loaded
+        overall while one bucket's queue is cycles deep and its twin on
+        another replica sits idle (the convoy stall the fleet bench
+        caught live — docs/SERVING.md "Fleet tier")."""
+        q = self.queues.get(tuple(bucket))
+        return len(q) if q is not None else 0
+
+    def alive(self) -> bool:
+        """Liveness: not closed and every bucket dispatcher thread still
+        running (a dispatcher that died leaves its bucket permanently
+        unserved — the health monitor must eject this replica)."""
+        if self._closed:
+            return False
+        return bool(self._threads) and all(t.is_alive()
+                                           for t in self._threads)
+
+    def kill(self) -> None:
+        """Abrupt-death simulation (fleet tests + ``make fleet-smoke``):
+        stop admitting, terminate everything still queued as FAILED (not
+        SHED — the replica died under them; the fleet router reroutes
+        FAILED work, Shed is a client-visible backpressure signal), let
+        the dispatchers exit.  A batch already mid-model completes —
+        same as a real preemption, where in-flight device work either
+        finishes or the whole process is gone."""
+        self._closed = True
+        err = RuntimeError("replica killed")
+        for q in self.queues.values():
+            for req in q.close():
+                if req._finish(FAILED, error=err):
+                    self.metrics.count("failed")
 
     def healthz(self) -> Dict:
         return {
@@ -314,6 +417,7 @@ class ServingEngine:
             "batch_size": self.cfg.serve.batch_size,
             "warm_programs": self._warm_programs,
             "programs": self.program_count(),
+            "export_root": self._export_root,  # None = trace-warmed
             "queue_depths": {f"{b[0]}x{b[1]}": len(q)
                              for b, q in self.queues.items()},
         }

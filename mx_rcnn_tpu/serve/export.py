@@ -1,0 +1,538 @@
+"""AOT-exported programs + persistent compilation cache (docs/SERVING.md
+"Fleet tier", docs/FT.md "Recovery time").
+
+No reference equivalent — the reference binds symbols at process start
+and re-traces on every shape change.  This module is the
+seconds-scale-cold-start half of the serving fleet (ROADMAP item 2) and
+the recovery-time lever of elastic training (ROADMAP item 5):
+
+* an :class:`ExportStore` is a directory of ``jax.export``-serialized
+  programs (StableHLO, weights NOT embedded — parameters stay checkpoint
+  arguments) plus a ``manifest.json`` naming the config fingerprint,
+  bucket/batch shapes, and jax/jaxlib versions the programs were traced
+  under, plus the bundled XLA persistent-cache directory the export-time
+  verify pass populated;
+* a joining replica loads the store, refuses a manifest that does not
+  match its own config (a stale export would silently serve different
+  semantics), installs the deserialized programs into its
+  ``Predictor``'s program cache, and compiles them through the bundled
+  persistent cache — skipping BOTH tracing and XLA compilation, the two
+  stages that make today's trace-warm startup seconds-to-minutes;
+* the export-time verify pass pins every exported program's outputs
+  BIT-EQUAL to the live-traced program on the same inputs, so an
+  AOT-warmed replica cannot disagree with a trace-warmed one
+  (``tests/test_fleet.py`` pins the round trip; ``tools/loadgen.py
+  --fleet_bench`` re-checks it cross-process).
+
+``enable_compile_cache`` is the shared CLI startup hook
+(tools/train.py / tools/serve.py / tools/fleet.py): it points jax's
+persistent compilation cache at ``cfg.ft.compile_cache_dir`` in the
+LIVE process config AND the child environment, so supervisor relaunches
+(elastic EXIT_RESIZE restarts, crash-loop restarts) inherit the warm
+cache and pay tracing only.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+MANIFEST_NAME = "manifest.json"
+CACHE_SUBDIR = "xla_cache"
+
+
+class ExportMismatch(RuntimeError):
+    """The export store's manifest does not match this process's config /
+    jax version — loading it would serve programs traced under different
+    semantics.  Re-export (``tools/fleet.py export``) instead."""
+
+
+def enable_compile_cache(cache_dir: str, min_compile_s: float = 0.0) -> bool:
+    """Point jax's persistent compilation cache at ``cache_dir`` (no-op
+    when empty) — live config AND child env, so subprocesses (elastic
+    relaunches, fleet join benches) inherit it.  Returns True if armed."""
+    if not cache_dir:
+        return False
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_s)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:  # older jax without the knobs
+        logger.warning("persistent compile cache unavailable: %s", e)
+        return False
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+    os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = \
+        str(min_compile_s)
+    os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "0"
+    logger.info("persistent XLA compilation cache: %s", cache_dir)
+    return True
+
+
+def _spec_of(tree) -> Any:
+    """Pytree of arrays → pytree of ShapeDtypeStructs (the export arg
+    template)."""
+    import jax
+
+    return jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(np.asarray(a).shape,
+                                       np.asarray(a).dtype), tree)
+
+
+def _describe(tree) -> Any:
+    """JSON-able description of an arg pytree's leaf shapes/dtypes (for
+    the manifest — human auditing, not validation)."""
+    import jax
+
+    leaves = jax.tree.leaves(tree)
+    return [[list(np.asarray(a).shape), np.dtype(np.asarray(a).dtype).name]
+            for a in leaves]
+
+
+class ExportStore:
+    """A directory of serialized ``jax.export`` programs + manifest.
+
+    Layout::
+
+        <root>/manifest.json       fingerprint, versions, entries
+        <root>/<name>.jaxexp       serialized exported program
+        <root>/xla_cache/          persistent XLA cache the verify pass
+                                   populated (a joining replica's compile
+                                   becomes a cache read)
+
+    Writing: ``ExportStore.create(root, cfg)`` → ``add(...)`` per
+    program → ``finish()`` (manifest written LAST, atomically — a
+    half-written store never verifies).  Reading: ``ExportStore(root)``
+    → ``check(cfg)`` → ``load(name)``.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        self._manifest: Optional[Dict] = None
+        self._entries: Dict[str, Dict] = {}
+
+    # ------------------------------------------------------------------
+    # writing
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def create(cls, root: str, cfg, extra_meta: Dict = None
+               ) -> "ExportStore":
+        import jax
+
+        from mx_rcnn_tpu.utils.checkpoint import config_fingerprint
+
+        os.makedirs(root, exist_ok=True)
+        store = cls(root)
+        store._manifest = {
+            "kind": "mx_rcnn_tpu_export_store",
+            "config_fingerprint": config_fingerprint(cfg),
+            "jax_version": jax.__version__,
+            "jaxlib_version": getattr(jax, "jaxlib_version", None)
+            or __import__("jaxlib").version.__version__,
+            "bucket_shapes": [list(b) for b in cfg.bucket.shapes],
+            "num_classes": cfg.num_classes,
+            "entries": {},
+            **(extra_meta or {}),
+        }
+        return store
+
+    def add(self, name: str, fn: Callable, args: Tuple,
+            static_kwargs: Dict = None) -> None:
+        """Trace + export ``fn`` (a jitted callable) at the arg shapes of
+        ``args`` (arrays or ShapeDtypeStructs) and serialize it into the
+        store.  ``static_kwargs`` are baked into the program (they must
+        be the static args the live call site passes)."""
+        from jax import export as jexport
+
+        exp = jexport.export(fn)(*_spec_of(args), **(static_kwargs or {}))
+        blob = exp.serialize()
+        path = os.path.join(self.root, f"{name}.jaxexp")
+        with open(path, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        self._manifest["entries"][name] = {
+            "file": f"{name}.jaxexp",
+            "bytes": len(blob),
+            "sha256": hashlib.sha256(blob).hexdigest(),
+            "args": _describe(args),
+            "static": {k: v for k, v in (static_kwargs or {}).items()},
+        }
+
+    def finish(self) -> str:
+        """Commit the manifest (written LAST: its presence means every
+        program file it names is fully on disk)."""
+        path = os.path.join(self.root, MANIFEST_NAME)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._manifest, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+
+    # ------------------------------------------------------------------
+    # reading
+    # ------------------------------------------------------------------
+
+    def manifest(self) -> Dict:
+        if self._manifest is None:
+            path = os.path.join(self.root, MANIFEST_NAME)
+            with open(path) as f:
+                self._manifest = json.load(f)
+        return self._manifest
+
+    def cache_dir(self) -> str:
+        return os.path.join(self.root, CACHE_SUBDIR)
+
+    def check(self, cfg, allow_mismatch: bool = False) -> Dict:
+        """Admission check before any program loads: config fingerprint,
+        bucket shapes and jax version must match this process, else the
+        store serves different semantics than a live trace would —
+        refuse (``ExportMismatch``) unless ``allow_mismatch`` downgrades
+        to a WARNING (debugging only)."""
+        import jax
+
+        from mx_rcnn_tpu.utils.checkpoint import config_fingerprint
+
+        m = self.manifest()
+        problems: List[str] = []
+        fp = config_fingerprint(cfg)
+        if m.get("config_fingerprint") != fp:
+            problems.append(
+                f"config fingerprint {m.get('config_fingerprint')} != "
+                f"this run's {fp}")
+        if m.get("jax_version") != jax.__version__:
+            problems.append(f"jax {m.get('jax_version')} != running "
+                            f"{jax.__version__}")
+        want = [list(b) for b in cfg.bucket.shapes]
+        if m.get("bucket_shapes") != want:
+            problems.append(f"bucket shapes {m.get('bucket_shapes')} != "
+                            f"{want}")
+        # serving-semantics knobs live OUTSIDE the train-config
+        # fingerprint (serve/test sections are deliberately excluded
+        # from it), but they are baked into the exported programs as
+        # static args — a drifted value would silently serve different
+        # boxes.  Compare every recorded knob against this process.
+        for key, live in (("serve_batch_size", cfg.serve.batch_size),
+                          ("nms_thresh", cfg.test.nms),
+                          ("serve_score_thresh", cfg.serve.score_thresh),
+                          ("num_classes", cfg.num_classes)):
+            if key in m and m[key] != live:
+                problems.append(f"{key} {m[key]} != this run's {live}")
+        if problems:
+            msg = (f"export store {self.root} does not match this "
+                   f"process: " + "; ".join(problems))
+            if not allow_mismatch:
+                raise ExportMismatch(msg)
+            logger.warning("%s (allow_mismatch set — loading anyway)", msg)
+        return m
+
+    def load(self, name: str) -> Callable:
+        """Deserialize one program and wrap it in ``jax.jit`` so repeat
+        calls dispatch through the compiled-executable cache.  The first
+        call compiles the StableHLO — a persistent-cache READ when the
+        bundled ``xla_cache/`` is armed (``enable_compile_cache``)."""
+        import jax
+        from jax import export as jexport
+
+        entry = self.manifest()["entries"][name]
+        path = os.path.join(self.root, entry["file"])
+        with open(path, "rb") as f:
+            blob = f.read()
+        sha = hashlib.sha256(blob).hexdigest()
+        if sha != entry["sha256"]:
+            raise ExportMismatch(
+                f"export {path} is corrupt: sha256 {sha} != manifest "
+                f"{entry['sha256']}")
+        return jax.jit(jexport.deserialize(blob).call)
+
+    def names(self) -> Tuple[str, ...]:
+        return tuple(sorted(self.manifest()["entries"]))
+
+
+# ---------------------------------------------------------------------------
+# serving-program export (the fleet tier's AOT artifacts)
+# ---------------------------------------------------------------------------
+
+def serve_fwd_name(bucket: Tuple[int, int], batch: int) -> str:
+    return f"serve_fwd_{bucket[0]}x{bucket[1]}_b{batch}"
+
+
+def eval_fwd_name(bucket: Tuple[int, int], batch: int) -> str:
+    return f"eval_fwd_{bucket[0]}x{bucket[1]}_b{batch}"
+
+
+SERVE_POST = "serve_post"
+
+
+def _dummy_batch(bucket: Tuple[int, int], n: int, seed: int = 0
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic non-trivial verify inputs (zeros would let a broken
+    program pass bit-equality on degenerate outputs)."""
+    bh, bw = bucket
+    rng = np.random.RandomState(seed + bh * 7 + bw)
+    images = rng.rand(n, bh, bw, 3).astype(np.float32) * 255.0
+    im_info = np.tile(np.array([bh, bw, 1.0], np.float32), (n, 1))
+    return images, im_info
+
+
+def export_serve_programs(predictor, cfg, root: str, *,
+                          eval_batch: int = None, verify: bool = True
+                          ) -> Dict:
+    """Export every per-bucket serving program + the shared postprocess
+    (+ the eval ``Predictor`` step at ``eval_batch`` rows) into an
+    :class:`ExportStore` at ``root``, and — unless ``verify=False`` —
+    pin each exported program's outputs BIT-EQUAL to the live-traced
+    program on deterministic inputs.  The verify pass doubles as the
+    persistent-cache population step: run it with
+    ``enable_compile_cache(store.cache_dir())`` armed and a joining
+    replica's compiles become cache reads.
+
+    Returns a report dict (programs, bytes, verified flags) that
+    ``tools/fleet.py export`` prints and the manifest summarizes.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from mx_rcnn_tpu.core.tester import _postprocess_batch, tiled_bbox_stats
+
+    model = predictor.model
+    variables = predictor.variables
+    n = cfg.serve.batch_size
+    buckets = [tuple(b) for b in cfg.bucket.shapes]
+    store = ExportStore.create(
+        root, cfg, extra_meta={
+            "serve_batch_size": n,
+            "eval_batch_size": eval_batch,
+            "nms_thresh": cfg.test.nms,
+            "serve_score_thresh": cfg.serve.score_thresh,
+        })
+    report: Dict = {"root": root, "programs": [], "verified": verify,
+                    "bit_equal": None}
+
+    def fwd_fn():
+        @jax.jit
+        def fn(variables, images, im_info):
+            return model.apply(variables, images, im_info)
+
+        return fn
+
+    stds, means = tiled_bbox_stats(cfg, cfg.num_classes)
+    all_equal = True
+    post_done = False
+    # per-bucket forward at the serve batch (and the eval batch when it
+    # differs) + ONE postprocess at the serve shapes
+    sizes = [n] + ([eval_batch] if eval_batch and eval_batch != n else [])
+    for bucket in buckets:
+        for rows in sizes:
+            images, im_info = _dummy_batch(bucket, rows)
+            fn = fwd_fn()
+            name = (serve_fwd_name(bucket, rows) if rows == n
+                    else eval_fwd_name(bucket, rows))
+            store.add(name, fn, (variables, images, im_info))
+            if verify:
+                live = fn(variables, images, im_info)
+                loaded = _load_unfinished(store, name)
+                got = loaded(variables, images, im_info)
+                eq = _bit_equal(live, got)
+                all_equal &= eq
+                report["programs"].append(
+                    {"name": name, "bit_equal": eq})
+                if rows == n and not post_done:
+                    # the postprocess program, exported at the shapes the
+                    # forward actually produces (and verified on REAL
+                    # forward outputs, not synthetic tensors)
+                    rois, roi_valid, cls_prob, deltas = live
+                    post_args = (rois, roi_valid, cls_prob, deltas,
+                                 jnp.asarray(im_info),
+                                 jnp.asarray(im_info[:, 2]), stds, means)
+                    statics = {"nms_thresh": cfg.test.nms,
+                               "score_thresh": cfg.serve.score_thresh}
+                    store.add(SERVE_POST, _postprocess_batch, post_args,
+                              static_kwargs=statics)
+                    live_post = _postprocess_batch(*post_args, **statics)
+                    got_post = _load_unfinished(store, SERVE_POST)(
+                        *post_args)
+                    eq = _bit_equal(live_post, got_post)
+                    all_equal &= eq
+                    report["programs"].append(
+                        {"name": SERVE_POST, "bit_equal": eq})
+                    post_done = True
+            else:
+                report["programs"].append({"name": name})
+    if not verify and not post_done:
+        # still need the postprocess export: trace shapes via one live run
+        images, im_info = _dummy_batch(buckets[0], n)
+        rois, roi_valid, cls_prob, deltas = fwd_fn()(variables, images,
+                                                     im_info)
+        post_args = (rois, roi_valid, cls_prob, deltas,
+                     jnp.asarray(im_info), jnp.asarray(im_info[:, 2]),
+                     stds, means)
+        store.add(SERVE_POST, _postprocess_batch, post_args,
+                  static_kwargs={"nms_thresh": cfg.test.nms,
+                                 "score_thresh": cfg.serve.score_thresh})
+        report["programs"].append({"name": SERVE_POST})
+    manifest_path = store.finish()
+    report["manifest"] = manifest_path
+    report["bit_equal"] = all_equal if verify else None
+    report["bytes"] = sum(e["bytes"]
+                          for e in store.manifest()["entries"].values())
+    if verify and not all_equal:
+        raise ExportMismatch(
+            "exported program outputs are NOT bit-equal to the live "
+            "trace — refusing to commit a store that would serve "
+            "different results (see report)")
+    return report
+
+
+def _load_unfinished(store: ExportStore, name: str) -> Callable:
+    """Load from a store still being written (manifest not committed):
+    deserialize the just-written blob directly."""
+    import jax
+    from jax import export as jexport
+
+    path = os.path.join(store.root, f"{name}.jaxexp")
+    with open(path, "rb") as f:
+        return jax.jit(jexport.deserialize(f.read()).call)
+
+
+def _bit_equal(a, b) -> bool:
+    import jax
+
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.asarray(x).dtype == np.asarray(y).dtype
+        and np.asarray(x).shape == np.asarray(y).shape
+        and (np.asarray(x) == np.asarray(y)).all()
+        for x, y in zip(la, lb))
+
+
+# ---------------------------------------------------------------------------
+# train-step export (ROADMAP item 5 — AOT step artifact)
+# ---------------------------------------------------------------------------
+
+def export_train_step(cfg, *, out_dir: str, num_devices: int = 1,
+                      grad_accum: int = 1, seed: int = 0,
+                      verify: bool = True) -> Dict:
+    """Export the jitted train step for the current recipe/topology as a
+    portable AOT artifact (``<out_dir>/train_step.jaxexp`` + manifest),
+    verified bit-equal against the live-traced step on one synthetic
+    batch.
+
+    The exported step takes ``(state, batch, key)`` like the live one
+    but carries NO donation metadata (``jax.export`` serializes the
+    program, not the buffer-aliasing policy) — it is the
+    scheduler-shippable program artifact and the persistent-cache
+    pre-warmer, not a drop-in replacement for the fit loop's donating
+    step.  The compile-skip on restart comes from
+    ``ft.compile_cache_dir`` (``enable_compile_cache``); docs/FT.md
+    "Recovery time" has the measured deltas.
+    """
+    import jax
+
+    from mx_rcnn_tpu.core.train import make_train_step, setup_training
+    from mx_rcnn_tpu.models import build_model
+
+    if num_devices != 1:
+        raise NotImplementedError(
+            "train-step export currently covers the single-device step "
+            "(the elastic relaunch path compiles the sharded step "
+            "through the persistent cache instead)")
+    model = build_model(cfg)
+    bh, bw = cfg.bucket.shapes[0]
+    key = jax.random.PRNGKey(seed)
+    state, tx = setup_training(model, cfg, key,
+                               (cfg.train.batch_images, bh, bw, 3),
+                               steps_per_epoch=100)
+    step = make_train_step(model, cfg, tx, grad_accum=grad_accum)
+    batch = _synthetic_train_batch(cfg, seed)
+    # export over FLATTENED leaves: the TrainState/optax-state pytree
+    # types (EmptyState, ScaleByAdamState, flax structs) have no
+    # jax.export serialization registered, and registering every
+    # optimizer internal would couple the artifact to optax's private
+    # layout — a flat (arrays in) -> (arrays out) program sidesteps the
+    # whole class.  ``load_train_step`` rebuilds the treedefs from the
+    # caller's own live state (same recipe => same structure).
+    args_leaves, args_tree = jax.tree.flatten((state, batch, key))
+
+    @jax.jit
+    def step_flat(*leaves):
+        s, b, k = jax.tree.unflatten(args_tree, leaves)
+        return tuple(jax.tree.leaves(step(s, b, k)))
+
+    store = ExportStore.create(out_dir, cfg, extra_meta={
+        "train_step": True, "num_devices": num_devices,
+        "grad_accum": grad_accum,
+        "batch_images": cfg.train.batch_images})
+    store.add("train_step", step_flat, tuple(args_leaves))
+    report: Dict = {"root": out_dir, "programs": [{"name": "train_step"}],
+                    "verified": verify, "bit_equal": None}
+    if verify:
+        live = jax.jit(step)(state, batch, key)
+        got_flat = _load_unfinished(store, "train_step")(*args_leaves)
+        got = jax.tree.unflatten(jax.tree.structure(live), got_flat)
+        eq = _bit_equal(live, got)
+        report["bit_equal"] = eq
+        report["programs"][0]["bit_equal"] = eq
+        if not eq:
+            raise ExportMismatch(
+                "exported train step is NOT bit-equal to the live trace")
+    report["manifest"] = store.finish()
+    report["bytes"] = store.manifest()["entries"]["train_step"]["bytes"]
+    return report
+
+
+def load_train_step(store: ExportStore, state, batch, key) -> Callable:
+    """Wrap the exported flat train-step program back into the live
+    ``(state, batch, key) -> (state, metrics)`` signature.  The flat
+    program carries no pytree structure, so the caller supplies live
+    templates (a state/batch built from the SAME recipe — ``check``
+    already pinned the config fingerprint); the output treedef is
+    reconstructed by shape: the leading output leaves refill the state
+    structure, the rest the metrics dict (keys recorded at export are in
+    the manifest for auditing)."""
+    import jax
+
+    fn = store.load("train_step")
+    args_tree = jax.tree.structure((state, batch, key))
+    state_tree = jax.tree.structure(state)
+    n_state = state_tree.num_leaves
+
+    def wrapped(s, b, k):
+        leaves = jax.tree.leaves((s, b, k))
+        if len(leaves) != args_tree.num_leaves:
+            raise ExportMismatch(
+                f"train-step args have {len(leaves)} leaves, export "
+                f"was traced with {args_tree.num_leaves}")
+        out = fn(*leaves)
+        new_state = jax.tree.unflatten(state_tree, out[:n_state])
+        return new_state, list(out[n_state:])
+
+    return wrapped
+
+
+def _synthetic_train_batch(cfg, seed: int):
+    """One deterministic training batch at the recipe's static shapes
+    (synthetic pixels/boxes — the export traces shapes, not content)."""
+    from mx_rcnn_tpu.data import load_gt_roidb
+    from mx_rcnn_tpu.data.loader import AnchorLoader
+
+    kw = {}
+    if cfg.dataset.name.startswith("synthetic"):
+        kw["num_images"] = max(cfg.train.batch_images * 2, 4)
+    _, roidb = load_gt_roidb(cfg, training=True, **kw)
+    loader = AnchorLoader(roidb, cfg, batch_images=cfg.train.batch_images,
+                          shuffle=False, seed=seed)
+    return next(iter(loader))

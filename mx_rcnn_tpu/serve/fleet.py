@@ -1,0 +1,612 @@
+"""Serving fleet: replica manager + join-shortest-queue front-end router.
+
+No reference equivalent — this is the tier above ``serve/engine.py``
+(ROADMAP item 2): N replica engines, each a full
+:class:`~mx_rcnn_tpu.serve.engine.ServingEngine` over its OWN
+``Predictor`` on its own device subset (a subset of size > 1 becomes the
+replica's 1-D data mesh — the mesh-sharded inference math from
+``core/tester.py``, per replica), behind a router that:
+
+* **spreads load** by batch-aware join-shortest-queue: primary key is
+  the batch-cycle backlog of the request's own bucket lane
+  (``ServingEngine.bucket_depth``), so same-bucket traffic packs full
+  micro-batches; per-replica in-flight depth
+  (``ServeMetrics.in_flight`` — one lock, five counter reads) breaks
+  ties, a rotating index breaks those;
+* **composes with the existing overload semantics** rather than
+  replacing them: deadlines are fleet-scoped (a reroute never extends
+  one; a request that expires DURING routing terminates EXPIRED before
+  touching a replica), and shed stays watermark-driven — JSQ routes to
+  the least-loaded replica, so an admission shed there means every
+  replica is at/over its watermark and the fleet answer is 429;
+* **keeps the terminate-exactly-once invariant fleet-wide**: the
+  client-facing :class:`FleetRequest` reaches exactly one terminal state
+  no matter how many replica-level requests served it (a replica that
+  dies with queued work FAILs it; the router re-dispatches within the
+  deadline up to ``fleet.reroute_retries`` times, then fails honestly);
+* **ejects and relaunches**: a health monitor removes dead replicas from
+  the routing set, terminates their stranded work (which reroutes), and
+  rebuilds them through the ``ft/supervisor.py — RestartPolicy`` backoff
+  schedule — repeated identical launch failures become a crash-loop
+  verdict instead of an infinite rebuild loop.
+
+Cold replicas join warm-from-export (``serve/export.py``) in seconds:
+deserialized AOT programs install straight into the Predictor's program
+cache, so a join pays neither tracing nor (with the bundled persistent
+cache) XLA compilation.  Architecture + measured numbers:
+docs/SERVING.md "Fleet tier".
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.obs.metrics import Registry, ServeMetrics
+from mx_rcnn_tpu.obs.metrics import registry as process_registry
+from mx_rcnn_tpu.serve.engine import ServingEngine
+from mx_rcnn_tpu.serve.queue import (EXPIRED, FAILED, PENDING, SERVED, SHED,
+                                     RequestFailed, ServeRequest)
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+# replica lifecycle states (healthz-visible)
+R_STARTING = "starting"
+R_READY = "ready"
+R_EJECTED = "ejected"
+R_RELAUNCHING = "relaunching"
+R_DEAD = "dead"          # crash-loop verdict or relaunch disabled
+
+
+class FleetMetrics(ServeMetrics):
+    """Fleet-level request accounting: same counters / histograms /
+    snapshot format as :class:`ServeMetrics` (so ``serve/server.py`` and
+    the loadgen read a router exactly like an engine) under the
+    ``fleet.`` prefix — per-replica engines keep their own ``serve.``
+    metrics in PRIVATE registries, so fleet and replica counts never
+    double-report into one scrape."""
+
+    PREFIX = "fleet."
+
+
+class FleetRequest(ServeRequest):
+    """The client-facing handle: one terminal state, fleet-wide.
+
+    ``image`` holds the RAW client image (replica engines preprocess per
+    dispatch — a reroute re-resizes, trading a few host ms for not
+    caching canvases twice); it is dropped at the terminal transition so
+    a drained burst holds no pixel memory.
+    """
+
+    __slots__ = ("attempts", "tried", "replica_id")
+
+    def __init__(self, image: np.ndarray, deadline: Optional[float],
+                 now: float):
+        super().__init__(image, None, None, deadline, now)
+        self.attempts = 0          # dispatches so far (1 = no reroute)
+        self.tried: set = set()    # replica ids already dispatched to
+        self.replica_id: Optional[int] = None  # last dispatch target
+
+
+class Replica:
+    """One managed serving replica: engine + lifecycle + restart pacing.
+
+    ``build_fn(replica_id) -> (engine, join_stats)`` builds a WARMED
+    engine (export-warm or trace-warm — the manager records which and
+    how long).  All state transitions happen under ``_lock``; the
+    routing set reads ``ready()`` lock-free-ish (one lock hop).
+    """
+
+    def __init__(self, rid: int,
+                 build_fn: Callable[[int], Tuple[ServingEngine, Dict]],
+                 policy=None):
+        from mx_rcnn_tpu.ft.supervisor import RestartPolicy
+
+        self.id = rid
+        self.build_fn = build_fn
+        self.engine: Optional[ServingEngine] = None
+        self.state = R_STARTING
+        self.closed = False        # manager shut down: launches refuse
+        self.generation = 0        # successful launches
+        self.joins: List[Dict] = []
+        self.relaunch_at: Optional[float] = None
+        # private registry: N policies would otherwise fight over the
+        # shared ft.supervisor.* gauge names
+        self.policy = policy or RestartPolicy(seed=rid,
+                                              registry=Registry())
+        self._lock = threading.RLock()
+
+    def launch(self) -> bool:
+        """Build + warm the engine (blocking; seconds export-warm).
+        Returns success; the caller owns failure pacing."""
+        with self._lock:
+            if self.closed:
+                return False
+            self.state = R_STARTING
+        try:
+            t0 = time.perf_counter()
+            engine, join = self.build_fn(self.id)
+        except Exception:
+            logger.exception("replica %d launch failed", self.id)
+            with self._lock:
+                self.engine = None
+            return False
+        join = dict(join or {})
+        join["join_s"] = round(time.perf_counter() - t0, 3)
+        join["ready_t"] = time.monotonic()  # rejoin-latency accounting
+        with self._lock:
+            if self.closed:
+                # manager closed while this build was in flight: a late
+                # READY would resurrect the replica with an engine
+                # nobody will ever close
+                self.state = R_DEAD
+                stale = engine
+            else:
+                stale = None
+        if stale is not None:
+            stale.close()
+            return False
+        with self._lock:
+            self.engine = engine
+            self.generation += 1
+            self.joins.append(join)
+            self.state = R_READY
+        logger.info("replica %d ready (generation %d, join %.2fs, %s)",
+                    self.id, self.generation, join["join_s"],
+                    "export-warm" if join.get("export_root")
+                    else "trace-warm")
+        return True
+
+    def ready(self) -> bool:
+        with self._lock:
+            return self.state == R_READY and self.engine is not None
+
+    def depth(self) -> float:
+        """JSQ signal; an unready replica reads infinitely deep."""
+        with self._lock:
+            if self.state != R_READY or self.engine is None:
+                return float("inf")
+            return self.engine.depth()
+
+    def describe(self) -> Dict:
+        with self._lock:
+            eng = self.engine
+            d = {"id": self.id, "state": self.state,
+                 "generation": self.generation,
+                 "last_join_s": (self.joins[-1]["join_s"]
+                                 if self.joins else None)}
+            if eng is not None and self.state == R_READY:
+                d["depth"] = eng.depth()
+                d["programs"] = eng.program_count()
+                d["export_root"] = eng._export_root
+            return d
+
+
+class ReplicaManager:
+    """Owns the replica set: boot, health monitoring, eject, relaunch.
+
+    The health loop (every ``fleet.health_interval_s``) ejects replicas
+    whose engine died (closed, or a bucket dispatcher thread gone —
+    its bucket would be permanently unserved), kills their stranded
+    queue (FAILED → the router reroutes), and relaunches on the
+    RestartPolicy schedule in a dedicated thread so one slow rebuild
+    never blinds monitoring of the others.  ``made_progress`` for the
+    policy = the dead generation served at least one request, so a
+    replica that keeps dying before its first serve escalates to the
+    crash-loop verdict while preemption-style churn restarts freely.
+    """
+
+    def __init__(self, build_fn: Callable[[int], Tuple[ServingEngine, Dict]],
+                 cfg: Config, registry: Registry = None):
+        if cfg.fleet.replicas < 1:
+            raise ValueError(
+                f"fleet.replicas must be >= 1, got {cfg.fleet.replicas}")
+        self.cfg = cfg
+        self.replicas = [Replica(i, build_fn)
+                         for i in range(cfg.fleet.replicas)]
+        self.registry = registry or process_registry()
+        self.ejects = 0
+        self.relaunches = 0
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> "ReplicaManager":
+        """Launch every replica (sequentially — replica warmups contend
+        for the same host cores; concurrent builds measured slower on
+        the 1-core tier) then start the health monitor."""
+        for r in self.replicas:
+            if not r.launch():
+                self._schedule_relaunch(r, ("boot-failed",),
+                                        made_progress=False)
+        self._monitor = threading.Thread(target=self._health_loop,
+                                         name="fleet-health", daemon=True)
+        self._monitor.start()
+        return self
+
+    def close(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout)
+        for r in self.replicas:
+            with r._lock:
+                r.closed = True
+                eng, r.engine, r.state = r.engine, None, R_DEAD
+            if eng is not None:
+                eng.close(timeout)
+
+    # ------------------------------------------------------------------
+    # routing set
+    # ------------------------------------------------------------------
+
+    def ready_replicas(self) -> List[Replica]:
+        return [r for r in self.replicas if r.ready()]
+
+    # ------------------------------------------------------------------
+    # health
+    # ------------------------------------------------------------------
+
+    def _health_loop(self) -> None:
+        interval = max(self.cfg.fleet.health_interval_s, 0.05)
+        while not self._stop.wait(interval):
+            try:
+                self.tick()
+            except Exception:  # monitor must never die silently
+                logger.exception("fleet health tick failed")
+
+    def tick(self, now: float = None) -> None:
+        """One health pass (public so tests drive it deterministically
+        without the wall-clock loop)."""
+        now = time.monotonic() if now is None else now
+        for r in self.replicas:
+            with r._lock:
+                state, eng, due = r.state, r.engine, r.relaunch_at
+            if state == R_READY and (eng is None or not eng.alive()):
+                self.eject(r, "engine-dead")
+            elif state == R_RELAUNCHING and due is not None and now >= due:
+                with r._lock:
+                    if r.state != R_RELAUNCHING or r.relaunch_at != due:
+                        continue  # someone else picked it up
+                    r.relaunch_at = None
+                threading.Thread(target=self._relaunch, args=(r,),
+                                 name=f"fleet-relaunch-{r.id}",
+                                 daemon=True).start()
+        self.export_gauges()
+
+    def eject(self, r: Replica, reason: str) -> None:
+        """Remove a replica from the routing set and terminate its
+        stranded queue (FAILED — the router's reroute path picks the
+        work up); then schedule the relaunch."""
+        with r._lock:
+            if r.state not in (R_READY, R_STARTING):
+                return
+            r.state = R_EJECTED
+            eng = r.engine
+        self.ejects += 1
+        served = 0
+        if eng is not None:
+            eng.kill()
+            served = eng.metrics.counters["served"]
+        logger.warning("replica %d ejected (%s) after serving %d "
+                       "requests this generation", r.id, reason, served)
+        self._schedule_relaunch(r, (reason,), made_progress=served > 0)
+
+    def _schedule_relaunch(self, r: Replica, signature: tuple,
+                           made_progress: bool) -> None:
+        if not self.cfg.fleet.relaunch:
+            with r._lock:
+                r.state = R_DEAD
+            return
+        delay, give_up = r.policy.record(signature, made_progress)
+        with r._lock:
+            if give_up or r.closed:
+                r.state = R_DEAD
+                return
+            r.state = R_RELAUNCHING
+            r.relaunch_at = time.monotonic() + delay
+
+    def _relaunch(self, r: Replica) -> None:
+        self.relaunches += 1
+        if r.launch():
+            r.policy.record(("rejoined",), made_progress=True)
+            logger.info("replica %d rejoined the fleet", r.id)
+        else:
+            self._schedule_relaunch(r, ("launch-failed",),
+                                    made_progress=False)
+
+    def export_gauges(self) -> None:
+        """Fleet state → obs registry gauges (scheduler-visible, like
+        the elastic gauges): readiness, per-replica depth/generation,
+        eject/relaunch counts."""
+        g = self.registry.set_gauge
+        g("fleet.replicas", len(self.replicas))
+        g("fleet.replicas_ready", len(self.ready_replicas()))
+        g("fleet.ejects", self.ejects)
+        g("fleet.relaunches", self.relaunches)
+        for r in self.replicas:
+            d = r.depth()
+            g(f"fleet.replica{r.id}.depth",
+              -1.0 if d == float("inf") else d)
+            g(f"fleet.replica{r.id}.generation", r.generation)
+
+
+class FleetRouter:
+    """The fleet front end: same submit/detect/healthz/metrics surface
+    as a single :class:`ServingEngine`, so ``serve/server.py`` serves a
+    fleet through the identical HTTP handler (duck typing is the whole
+    interface contract — pinned by tests).
+    """
+
+    def __init__(self, manager: ReplicaManager, cfg: Config,
+                 metrics: FleetMetrics = None):
+        self.manager = manager
+        self.cfg = cfg
+        self.metrics = metrics or FleetMetrics()
+        self._rr = itertools.count()  # JSQ tie-break rotation
+
+    # ------------------------------------------------------------------
+    # request path
+    # ------------------------------------------------------------------
+
+    def submit(self, img: np.ndarray,
+               timeout_ms: float = None) -> FleetRequest:
+        """Admit one image fleet-wide; returns the fleet handle (same
+        wait()/state contract as ``ServingEngine.submit``)."""
+        now = time.monotonic()
+        t = (self.cfg.serve.default_timeout_ms if timeout_ms is None
+             else timeout_ms)
+        deadline = now + t / 1000.0 if t and t > 0 else None
+        freq = FleetRequest(img, deadline, now)
+        self.metrics.count("submitted")
+        self._dispatch(freq)
+        return freq
+
+    def detect(self, img: np.ndarray, timeout_ms: float = None):
+        req = self.submit(img, timeout_ms=timeout_ms)
+        wait_s = None
+        if req.deadline is not None:
+            wait_s = max(req.deadline - time.monotonic(), 0.0) + 30.0
+        return req.wait(timeout=wait_s)
+
+    def _route_bucket(self, freq: FleetRequest) -> Tuple[int, int]:
+        """The bucket this image will serve in (dims-only shape math —
+        the same resolution ``ServingEngine.submit`` uses for its
+        pre-admission check), computed once and cached on the request so
+        reroutes don't repeat it."""
+        if freq.bucket is None:
+            from mx_rcnn_tpu.data.image import estimate_bucket
+
+            h, w = freq.image.shape[:2]
+            freq.bucket = estimate_bucket(
+                h, w, self.cfg.bucket.scale, self.cfg.bucket.max_size,
+                [tuple(b) for b in self.cfg.bucket.shapes])
+        return freq.bucket
+
+    def _dispatch(self, freq: FleetRequest) -> None:
+        """Route (or re-route) one request: deadline check FIRST (a
+        request that expired during routing/reroute terminates EXPIRED —
+        it must never consume a replica slot), then batch-aware JSQ over
+        the ready set minus replicas this request already tried.
+
+        The JSQ key is (batch cycles ahead in this request's BUCKET
+        lane, total in-flight depth, rotating tie-break): primary is
+        ``ceil((lane_queue + 1) / batch)`` — how many dispatch cycles
+        until this request would serve — so same-bucket traffic packs
+        full batches and spreads lanes evenly; replica-blind total depth
+        alone let one replica's lane run cycles deep while its twin on
+        the other replica idled (a measured ~5-cycle convoy stall, and
+        partial-batch padding, both visible in the fleet bench)."""
+        now = time.monotonic()
+        if freq.expired(now):
+            if freq._finish(EXPIRED):
+                self.metrics.count("expired")
+                freq.image = None
+            return
+        cands = [r for r in self.manager.ready_replicas()
+                 if r.id not in freq.tried]
+        if not cands:
+            err = RequestFailed(
+                "no ready replica to serve this request "
+                f"(tried {sorted(freq.tried) or 'none'})")
+            if freq._finish(FAILED, error=err):
+                self.metrics.count("failed")
+                freq.image = None
+            return
+        bucket = self._route_bucket(freq)
+        batch = self.cfg.serve.batch_size
+        rot = next(self._rr)
+
+        def _score(r: Replica):
+            with r._lock:
+                eng = r.engine if r.state == R_READY else None
+            if eng is None:
+                return (float("inf"), float("inf"), 0)
+            cycles = -(-(eng.bucket_depth(bucket) + 1) // batch)
+            return (cycles, r.depth(), (r.id + rot) % len(cands))
+
+        target = min(cands, key=_score)
+        freq.tried.add(target.id)
+        freq.attempts += 1
+        freq.replica_id = target.id
+        with target._lock:
+            eng = target.engine if target.state == R_READY else None
+        if eng is None:  # lost the race with an eject — try the rest
+            self._dispatch(freq)
+            return
+        remaining_ms = (0.0 if freq.deadline is None
+                        else max((freq.deadline - now) * 1000.0, 0.001))
+        inner = eng.submit(freq.image, timeout_ms=remaining_ms)
+        inner.add_done_callback(
+            lambda done, _freq=freq, _eng=eng:
+            self._on_inner_done(_freq, done, _eng))
+
+    def _on_inner_done(self, freq: FleetRequest, inner: ServeRequest,
+                       eng: ServingEngine = None) -> None:
+        """Inner terminal → fleet terminal (or reroute).  Runs on
+        whichever thread terminated the inner request — dispatcher,
+        health monitor (via ``engine.kill``) or the submitting caller
+        (immediate shed) — and is the ONLY place a fleet request
+        terminates after dispatch, so fleet accounting mirrors the
+        per-request exactly-once guarantee."""
+        state = inner.state
+        if state == SERVED:
+            freq.batch_rows = inner.batch_rows
+            if freq._finish(SERVED, result=inner.result):
+                self.metrics.count("served")
+                self.metrics.observe(
+                    "total_ms", (freq.done_t - freq.enqueue_t) * 1e3)
+                freq.image = None
+        elif state == SHED:
+            if eng is not None and eng._closed:
+                # not a watermark shed: the engine was killed/closed in
+                # the submit race window — treat as replica death, not
+                # client-visible backpressure
+                self._retry_or_fail(freq, inner)
+                return
+            # JSQ sent this to the least-loaded replica; its watermark
+            # shed means the whole fleet is saturated — 429, immediately
+            if freq._finish(SHED):
+                self.metrics.count("shed")
+                freq.image = None
+        elif state == EXPIRED:
+            if freq._finish(EXPIRED):
+                self.metrics.count("expired")
+                freq.image = None
+        else:  # FAILED — replica died under it, or the batch errored
+            self._retry_or_fail(freq, inner)
+
+    def _retry_or_fail(self, freq: FleetRequest,
+                       inner: ServeRequest) -> None:
+        """Re-dispatch a replica-failure within the deadline and retry
+        budget; reroutes never extend the deadline.  A request already
+        past its deadline terminates EXPIRED, not FAILED — had the
+        replica lived, its dispatcher would have cancelled the request
+        at take (cancel-expired-before-dispatch); the deadline authority
+        outranks the replica's death."""
+        if freq.expired(time.monotonic()):
+            if freq._finish(EXPIRED):
+                self.metrics.count("expired")
+                freq.image = None
+            return
+        if freq.attempts < 1 + max(self.cfg.fleet.reroute_retries, 0):
+            self.metrics.count("rerouted")
+            self._dispatch(freq)
+        elif freq._finish(FAILED, error=inner.error):
+            self.metrics.count("failed")
+            freq.image = None
+
+    # ------------------------------------------------------------------
+    # status surface (server.py-compatible)
+    # ------------------------------------------------------------------
+
+    def healthz(self) -> Dict:
+        reps = [r.describe() for r in self.manager.replicas]
+        ready = sum(1 for r in reps if r["state"] == R_READY)
+        return {
+            "ok": ready > 0,
+            "fleet": True,
+            "replicas": reps,
+            "ready": ready,
+            "ejects": self.manager.ejects,
+            "relaunches": self.manager.relaunches,
+            "buckets": [list(b) for b in self.cfg.bucket.shapes],
+            "batch_size": self.cfg.serve.batch_size,
+        }
+
+    def rerouted(self) -> int:
+        return self.metrics.registry.counter(
+            self.metrics.PREFIX + "rerouted")
+
+    def close(self, timeout: float = 10.0) -> None:
+        self.manager.close(timeout)
+
+
+# ---------------------------------------------------------------------------
+# fleet assembly helpers (tools/fleet.py, tools/loadgen.py, tests)
+# ---------------------------------------------------------------------------
+
+def partition_devices(n_replicas: int, devices: Sequence = None,
+                      per_replica: int = 0) -> List[List]:
+    """Split the device inventory into per-replica subsets.  Disjoint
+    slices while the supply lasts; replicas beyond it wrap around and
+    SHARE devices (the 1-core CPU tier runs every replica on the same
+    device — throughput then validates the router, not the silicon;
+    docs/SERVING.md "Fleet tier" is explicit about which is which)."""
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    if n_replicas < 1:
+        raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+    d = len(devices)
+    if per_replica <= 0:
+        per_replica = max(d // n_replicas, 1)
+    per_replica = min(per_replica, d)
+    return [[devices[(i * per_replica + j) % d]
+             for j in range(per_replica)] for i in range(n_replicas)]
+
+
+def make_engine_build_fn(cfg: Config, model, variables, *,
+                         export_root: str = None,
+                         run_fn_factory: Callable[[int], Callable] = None,
+                         devices: Sequence = None
+                         ) -> Callable[[int], Tuple[ServingEngine, Dict]]:
+    """The standard replica ``build_fn``: per-replica device subset →
+    (optional) per-replica data mesh → private Predictor → warmed engine.
+    ``export_root`` selects AOT warm-from-export; ``run_fn_factory``
+    (bench/test rigs) replaces the model path entirely."""
+    subsets = partition_devices(cfg.fleet.replicas, devices,
+                                cfg.fleet.devices_per_replica)
+
+    def build(rid: int) -> Tuple[ServingEngine, Dict]:
+        from mx_rcnn_tpu.core.tester import Predictor
+        from mx_rcnn_tpu.parallel.dp import device_mesh
+
+        sub = subsets[rid % len(subsets)]
+        if export_root:
+            # exported programs are nr_devices=1 modules: an export-warm
+            # replica runs single-device, PLACED on its subset's first
+            # device via a 1-device mesh (per-chip placement on real
+            # hardware); mesh-sharded replicas are a trace-warm feature
+            mesh = device_mesh(devices=sub[:1]) if len(sub) > 1 else None
+        else:
+            mesh = device_mesh(devices=sub) if len(sub) > 1 else None
+        run_fn = run_fn_factory(rid) if run_fn_factory else None
+        predictor = Predictor(model, variables, cfg, mesh=mesh)
+        engine = ServingEngine(predictor, cfg, run_fn=run_fn)
+        t0 = time.perf_counter()
+        if run_fn is not None:
+            engine.warmup()
+            join = {"stub": True}
+        elif export_root:
+            from mx_rcnn_tpu.serve.export import ExportStore
+
+            join = engine.warm_from_export(ExportStore(export_root))
+        else:
+            engine.warmup()
+            join = {}
+        join["warm_s"] = round(time.perf_counter() - t0, 3)
+        join["devices"] = len(sub)
+        return engine, join
+
+    return build
+
+
+def build_fleet(cfg: Config, model, variables, *, export_root: str = None,
+                run_fn_factory=None, devices=None,
+                registry: Registry = None) -> FleetRouter:
+    """One-call fleet: manager + router, replicas launched and warmed."""
+    build = make_engine_build_fn(cfg, model, variables,
+                                 export_root=export_root,
+                                 run_fn_factory=run_fn_factory,
+                                 devices=devices)
+    manager = ReplicaManager(build, cfg, registry=registry).start()
+    return FleetRouter(manager, cfg)

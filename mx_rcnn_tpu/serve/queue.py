@@ -66,7 +66,7 @@ class ServeRequest:
 
     __slots__ = ("image", "im_info", "bucket", "enqueue_t", "deadline",
                  "state", "result", "error", "dispatch_t", "done_t",
-                 "batch_rows", "trace_id", "_event", "_lock")
+                 "batch_rows", "trace_id", "_event", "_lock", "_on_done")
 
     def __init__(self, image: np.ndarray, im_info: np.ndarray,
                  bucket: Tuple[int, int], deadline: Optional[float],
@@ -85,6 +85,7 @@ class ServeRequest:
         self.trace_id = None        # obs/trace.py context id (None = off)
         self._event = threading.Event()
         self._lock = threading.Lock()
+        self._on_done = None        # fleet router hook (add_done_callback)
 
     def _finish(self, state: str, result=None,
                 error: BaseException = None, now: float = None) -> bool:
@@ -101,7 +102,24 @@ class ServeRequest:
             # admission, from WHICHEVER thread terminated the request
             obs_trace.async_end("serve.request", self.trace_id, state=state)
         self._event.set()
+        cb = self._on_done
+        if cb is not None:
+            cb(self)  # fleet hook, invoked exactly once (guarded above)
         return True
+
+    def add_done_callback(self, cb: Callable[["ServeRequest"], None]
+                          ) -> None:
+        """Register ``cb(request)`` to fire when the request reaches its
+        terminal state — from whichever thread terminates it, exactly
+        once.  If the request is ALREADY terminal, ``cb`` fires
+        immediately on the caller thread (no terminal transition can be
+        missed — the fleet router attaches after ``submit`` returns, and
+        shed-at-admission requests terminate inside ``submit``)."""
+        with self._lock:
+            if self.state == PENDING:
+                self._on_done = cb
+                return
+        cb(self)
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline

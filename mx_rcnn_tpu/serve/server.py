@@ -156,6 +156,11 @@ class DetectionHandler(BaseHTTPRequestHandler):
         except (RequestFailed, TimeoutError) as e:
             self._reply(500, {"error": str(e)})
             return
+        except ValueError as e:
+            # preprocess rejected the image (e.g. no bucket fits it
+            # after resize) — client input, not a server fault
+            self._reply(400, {"error": str(e)})
+            return
         if req.trace_id is not None:
             # the HTTP hop of the request's lifecycle (same trace id as
             # its queue/dispatch spans)

@@ -81,6 +81,7 @@ def _build(args, shard=None):
         data__ram_ceiling_mb=args.ram_ceiling_mb,
         data__streaming=True,
         default__num_workers=args.num_workers,
+        default__decode_procs=args.decode_procs,
         obs__enabled=False, **over)
     kw = {"num_images": args.num_images}
     _, roidb = load_gt_roidb(cfg, training=True, **kw)
@@ -341,6 +342,10 @@ def main(argv=None) -> int:
     p.add_argument("--test_images", type=int, default=1_000)
     p.add_argument("--batch_images", type=int, default=2)
     p.add_argument("--num_workers", type=int, default=2)
+    p.add_argument("--decode_procs", type=int, default=0,
+                   help="decode-pool worker processes for the streaming "
+                        "epoch leg (0 = in-thread decode; the ROADMAP "
+                        "item-3 multi-core validation sweeps 1/2/4)")
     p.add_argument("--num_shards", type=int, default=2,
                    help="worker PROCESSES in the shard rig")
     p.add_argument("--ram_ceiling_mb", type=int, default=4096)

@@ -14,6 +14,7 @@ ICI, see ``parallel/dp.py``).
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 
 import jax
@@ -521,6 +522,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "device (single-bucket datasets; for hosts/links "
                         "too slow to stream per step — see "
                         "data/device_cache.py)")
+    p.add_argument("--export_train_step", default=None, metavar="DIR",
+                   help="AOT-export the jitted train step for this "
+                        "recipe into DIR (serve/export.py — "
+                        "export_train_step: jax.export program + "
+                        "manifest, verified bit-equal to the live "
+                        "trace) and exit.  With ft.compile_cache_dir "
+                        "set, the export's verify pass also pre-warms "
+                        "the persistent cache the next (re)start reads "
+                        "— docs/FT.md 'Recovery time'")
     return p.parse_args(argv)
 
 
@@ -542,6 +552,23 @@ def main(argv=None):
                     "devices", jax.process_index(), jax.process_count(),
                     jax.local_device_count(), jax.device_count())
     cfg = config_from_args(args)
+    # persistent XLA compile cache (ROADMAP item 5 recovery-time lever,
+    # docs/FT.md "Recovery time"): armed BEFORE any compile, in the live
+    # config AND the child env — elastic EXIT_RESIZE relaunches and
+    # crash-loop restarts inherit it and pay tracing only
+    if cfg.ft.compile_cache_dir:
+        from mx_rcnn_tpu.serve.export import enable_compile_cache
+
+        enable_compile_cache(cfg.ft.compile_cache_dir)
+    if args.export_train_step:
+        from mx_rcnn_tpu.serve.export import export_train_step
+
+        report = export_train_step(
+            cfg, out_dir=args.export_train_step,
+            num_devices=args.num_devices, grad_accum=args.grad_accum,
+            seed=args.seed)
+        print(json.dumps(report))
+        return 0
     dataset_kw = None
     if args.dataset_kw:
         import ast

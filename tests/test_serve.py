@@ -349,6 +349,19 @@ def test_http_detect_healthz_metrics(http_server):
     assert status == 404
 
 
+def test_http_oversized_image_shrinks_to_fit(http_server):
+    """An image whose resize target exceeds every bucket must be shrunk
+    to fit (choose_bucket's contract, same step as the loader path) and
+    served — historically it escaped as a raw ValueError that killed the
+    handler thread (dropped connection, replica thread dead)."""
+    rng = np.random.default_rng(7)
+    img = rng.integers(0, 255, (900, 1400, 3), dtype=np.uint8)
+    status, body = _http(http_server + "/detect", {
+        "pixels_b64": base64.b64encode(img.tobytes()).decode(),
+        "shape": list(img.shape)})
+    assert status == 200 and "detections" in body
+
+
 def test_http_image_b64_roundtrip(http_server):
     """The encoded-file payload path decodes through the same BGR→RGB
     convention as ``imread_rgb``."""
