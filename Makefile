@@ -14,7 +14,8 @@ NATIVE_LIB := $(NATIVE_DIR)/libmxrcnn_native.so
 NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 
 .PHONY: all native lint test test-all test-gate serve-smoke ft-smoke \
-	obs-smoke perf-smoke elastic-smoke data-smoke fleet-smoke clean
+	obs-smoke perf-smoke elastic-smoke data-smoke fleet-smoke \
+	quant-smoke clean
 
 all: native
 
@@ -72,6 +73,18 @@ perf-smoke:
 		--network tiny --dataset synthetic --shape 128x160 \
 		--batch_images 2 --iters 2 --check
 
+# quantized-inference smoke (docs/PERF.md "Quantized inference"): train
+# the tiny model briefly, then assert the quant acceptance shape — fp
+# path bit-identical with quant off (and the quant model's param tree
+# unchanged, so fp32 checkpoints load), int8 eval mAP within the
+# configured delta budget of fp, the over-quantized red-team arm
+# (weight_bits=2) fires the gate, a quantized AOT export store
+# round-trips through warm_from_export with ZERO post-join recompiles,
+# and the manifest admission refuses fp-config and estimator-mismatch
+# loads.  ~2 min warm.
+quant-smoke:
+	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.quant_smoke --check
+
 # fault-tolerance smoke (docs/FT.md): a 2-kill crash loop on the tiny
 # model with synthetic data — one SIGTERM through the preemption path,
 # one torn-write + SIGKILL — auto-resumed via the integrity scanner;
@@ -127,10 +140,10 @@ elastic-smoke:
 # then the perf-tooling smoke (~1 min), the observability smoke
 # (~1 min), the streaming input-plane smoke (data-smoke, ~30 s), the
 # serving-fleet smoke (fleet-smoke, ~2 min), the 2-kill crash loop
-# (ft-smoke, ~2 min) and the elastic shrink/grow storm
-# (elastic-smoke, ~3 min)
+# (ft-smoke, ~2 min), the quantized-inference smoke (quant-smoke,
+# ~2 min) and the elastic shrink/grow storm (elastic-smoke, ~3 min)
 test-gate: lint serve-smoke perf-smoke obs-smoke data-smoke fleet-smoke \
-		ft-smoke elastic-smoke
+		quant-smoke ft-smoke elastic-smoke
 	python -m pytest tests/ -x -q -m "gate"
 
 clean:

@@ -144,6 +144,13 @@ class NetworkConfig:
     # -- TPU additions -------------------------------------------------------
     depth: int = 101                     # resnet depth (50 / 101 / 152)
     compute_dtype: str = "bfloat16"      # MXU-friendly activation dtype
+    # backbone layout lever (docs/PERF.md "Quantized inference"):
+    # zero-pad the stem's 3 input channels up to this count before conv0
+    # (4 aligns the channel axis; padded channels are exact zeros so the
+    # output is bit-identical — pinned by test).  Changes the conv0
+    # kernel's param shape, so it is a profile_step A/B lever
+    # (``--pad_stem``), not a checkpoint-compatible default.  0 = off.
+    stem_channel_pad: int = 0
 
     @property
     def num_anchors(self) -> int:
@@ -441,6 +448,62 @@ class ElasticConfig:
 
 
 @dataclass(frozen=True)
+class QuantConfig:
+    """TPU addition (no reference equivalent — the reference serves
+    fp32): policy knobs for the post-training quantized INFERENCE
+    forward (``ops/quant.py``, docs/PERF.md "Quantized inference").
+    Applies the Jacob et al. 2018 PTQ playbook to the serving/eval
+    forward: per-output-channel symmetric weight quantization +
+    per-tensor activation scales from an offline calibration sweep.
+
+    OFF by default; with ``enabled=False`` every fp serving/eval output
+    is BIT-identical to a build without the subsystem (pinned by
+    ``tests/test_quant.py``).  Training always runs fp — this section
+    is deliberately OUTSIDE the config fingerprint (like ``serve``/
+    ``test``), and the export-store manifest records the knobs plus the
+    calibration fingerprint instead, so a fleet replica can never mix
+    quantized and fp programs unknowingly (``serve/export.py``).
+
+    Same 3-level precedence as every section (hardcoded defaults <
+    presets < ``--set quant__field=value`` CLI overrides).
+    """
+
+    # master switch: quantize the inference forward (eval Predictor,
+    # serving engine, AOT exports); training is never quantized
+    enabled: bool = False
+    # container dtype: 'int8' (the int32-accumulate integer path) or
+    # 'fp8' (e4m3, fp32-accumulate)
+    dtype: str = "int8"
+    # 'native' runs the real low-precision program (int8×int8 →
+    # int32-accumulate dot/conv); 'sim' runs the same quantized integer
+    # values in fp32 arithmetic (the fake-quant proxy — pinned
+    # tile-level-equivalent to native by test)
+    mode: str = "native"
+    # activation-scale estimator over the calibration sweep: 'absmax'
+    # (running max of |x|) or 'percentile' (mean of the per-batch
+    # ``percentile``-th percentile of |x| — clips outlier tails)
+    estimator: str = "absmax"
+    percentile: float = 99.9
+    # effective integer bits of the int8 container, SHARED by the weight
+    # channels and the activation grid (both quantize to
+    # qmax = 2^(b-1)-1).  8 = production; lower values are the red-team
+    # over-quantization arm the accuracy gate must catch
+    # (tools/gauntlet.py quant_redteam)
+    weight_bits: int = 8
+    # calibration sweep: how many held-out TRAINING batches feed the
+    # activation statistics, and the seed of the deterministic
+    # subsample of the training roidb they are drawn from
+    calibration_batches: int = 2
+    calibration_seed: int = 0
+    # accuracy gate: |paired mAP delta| bound for the quantized arm,
+    # consumed by make quant-smoke.  The full gauntlet takes its own
+    # --budget flag (default 0.02) and the parity runbook its
+    # QUANT_TOLERANCE env — pass matching values there when gating
+    # `--compare e2e quant` on real data
+    map_delta_budget: float = 0.05
+
+
+@dataclass(frozen=True)
 class ObsConfig:
     """TPU addition (no reference equivalent — the reference's only
     instrument is the Speedometer stdout line): policy knobs for the
@@ -495,6 +558,7 @@ class Config:
     ft: FTConfig = field(default_factory=FTConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     elastic: ElasticConfig = field(default_factory=ElasticConfig)
+    quant: QuantConfig = field(default_factory=QuantConfig)
 
     @property
     def num_classes(self) -> int:

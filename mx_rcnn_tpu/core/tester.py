@@ -45,6 +45,25 @@ class Predictor:
         self.cfg = cfg
         self.mesh = mesh
         self._fns: Dict[Tuple[int, ...], callable] = {}
+        # quant mode (docs/PERF.md "Quantized inference"): a quantized
+        # Predictor tags every program key with the quant recipe + the
+        # calibration fingerprint, so quantized and fp programs can
+        # never share a cache slot (or an export-store slot) unnoticed
+        self.quant_fingerprint: str = None
+        self._kind_tag = ""
+        if cfg.quant.enabled:
+            from mx_rcnn_tpu.ops.quant import (calibration_fingerprint,
+                                               quant_program_tag)
+
+            if "quant" not in variables:
+                raise ValueError(
+                    "cfg.quant.enabled but variables carry no 'quant' "
+                    "collection — calibrate first (core/tester.py — "
+                    "quant_predictor)")
+            self.quant_fingerprint = calibration_fingerprint(
+                variables["quant"], cfg.quant)
+            self._kind_tag = quant_program_tag(
+                cfg.quant, self.quant_fingerprint) + ":"
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -84,14 +103,16 @@ class Predictor:
             out = tuple(o[:n] for o in out)
         return out
 
-    @staticmethod
-    def program_key(kind: str, arrays) -> Tuple:
+    def program_key(self, kind: str, arrays) -> Tuple:
         """The per-(mode, shape, dtype) program-cache key ``_forward``
         caches jitted functions under — keyed by mode AND shape AND
         dtype: uint8 raw batches and fp32 host-normalized batches compile
-        to different programs.  Public so the AOT export store
-        (``serve/export.py``) can address the same slots."""
-        return (kind,) + tuple(
+        to different programs.  In quant mode the kind is additionally
+        tagged with the quant recipe + calibration fingerprint, so a
+        quantized program can never collide with (or shadow) an fp one.
+        Public so the AOT export store (``serve/export.py``) can address
+        the same slots."""
+        return (self._kind_tag + kind,) + tuple(
             (tuple(a.shape), np.dtype(a.dtype).name) for a in arrays)
 
     def install_program(self, key: Tuple, fn) -> None:
@@ -361,6 +382,84 @@ def pred_eval(predictor: Predictor, test_loader, imdb, cfg: Config,
     results = imdb.evaluate_detections(all_boxes, out_dir) if out_dir \
         else imdb.evaluate_detections(all_boxes)
     return results
+
+
+def calibration_batches(cfg: Config, dataset_kw: dict = None):
+    """The held-out calibration sweep for quantized inference
+    (docs/PERF.md "Quantized inference"): ``cfg.quant.calibration_batches``
+    test-mode batches drawn from a DETERMINISTIC
+    ``cfg.quant.calibration_seed`` subsample of the TRAINING split —
+    never the eval set (the accuracy gate evaluates on it), and never
+    order-dependent on loader workers (the subsample is materialized
+    before the loader, and batches are consumed in roidb order).
+    Yields ``(images, im_info)`` pairs."""
+    from mx_rcnn_tpu.data import TestLoader, load_gt_roidb
+
+    q = cfg.quant
+    _, roidb = load_gt_roidb(cfg, training=True, **(dataset_kw or {}))
+    per_batch = max(1, cfg.test.batch_images)
+    want = max(1, q.calibration_batches) * per_batch
+    order = np.random.RandomState(q.calibration_seed).permutation(len(roidb))
+    roidb = [roidb[i] for i in order[:want]]
+    loader = TestLoader(roidb, cfg, batch_images=per_batch, num_workers=0)
+    out = []
+    for batch, _, _ in loader:
+        out.append((np.asarray(batch.images), np.asarray(batch.im_info)))
+        if len(out) >= q.calibration_batches:
+            break
+    return out
+
+
+def calibrate_quant(cfg: Config, params, batch_stats, *,
+                    dataset_kw: dict = None, batches=None):
+    """Run the calibration sweep and return the ``quant`` variables
+    collection (per-layer activation scales).  ``batches`` overrides the
+    default held-out sweep (bench rigs pass synthetic batches).
+    Deterministic: the same batches in the same order produce
+    bit-identical scales (pinned by ``tests/test_quant.py``)."""
+    import jax
+
+    from mx_rcnn_tpu.models import build_model
+    from mx_rcnn_tpu.ops.quant import finalize_calibration
+
+    if not cfg.quant.enabled:
+        raise ValueError("calibrate_quant needs cfg.quant.enabled")
+    calib_model = build_model(cfg, quant_phase="calib")
+    variables = {"params": params, "batch_stats": batch_stats}
+
+    @jax.jit
+    def sweep_one(variables, stats, images, im_info):
+        v = dict(variables)
+        if stats is not None:
+            v["quant_stats"] = stats
+        _, mut = calib_model.apply(v, images, im_info,
+                                   mutable=["quant_stats"])
+        return mut["quant_stats"]
+
+    stats = None
+    for images, im_info in (batches if batches is not None
+                            else calibration_batches(cfg, dataset_kw)):
+        stats = sweep_one(variables, stats, jnp.asarray(images),
+                          jnp.asarray(im_info))
+    if stats is None:
+        raise ValueError("calibration sweep saw zero batches")
+    return finalize_calibration(stats, cfg.quant)
+
+
+def quant_predictor(cfg: Config, params, batch_stats, mesh=None, *,
+                    dataset_kw: dict = None, batches=None) -> Predictor:
+    """Build the quantized-inference Predictor: calibration sweep →
+    ``quant`` scales collection → quantized model → Predictor (whose
+    program keys carry the quant tag + calibration fingerprint).  The
+    drop-in quant mode for eval (``tools/test.py``), serving
+    (``tools/serve.py`` / ``tools/fleet.py``) and the export store."""
+    from mx_rcnn_tpu.models import build_model
+
+    quant_col = calibrate_quant(cfg, params, batch_stats,
+                                dataset_kw=dataset_kw, batches=batches)
+    model = build_model(cfg)
+    return Predictor(model, {"params": params, "batch_stats": batch_stats,
+                             "quant": quant_col}, cfg, mesh=mesh)
 
 
 def generate_proposals(model: FasterRCNN, variables, test_loader, cfg: Config,

@@ -16,7 +16,7 @@ head → (rois, cls_prob, bbox_deltas), entirely inside one XLA program.
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -30,6 +30,7 @@ from mx_rcnn_tpu.models.vgg import VGGBackbone, VGGHead
 from mx_rcnn_tpu.ops.anchors import generate_shifted_anchors
 from mx_rcnn_tpu.ops.normalize import normalize_images
 from mx_rcnn_tpu.ops.proposal import propose_batch
+from mx_rcnn_tpu.ops.quant import QuantSpec
 from mx_rcnn_tpu.ops.roi_pool import roi_align
 
 Dtype = Any
@@ -56,6 +57,21 @@ class FasterRCNN(nn.Module):
     # batches (ops/normalize.py); fp32 host-normalized input passes through
     pixel_means: Tuple[float, ...] = (123.68, 116.779, 103.939)
     dtype: Dtype = jnp.float32
+    # inference-only quantization recipe (ops/quant.py — cfg.quant):
+    # covers the backbone convs and the head trunk; the RPN head and the
+    # final cls_score/bbox_pred projections stay fp (first/last-layer
+    # exemption, standard PTQ playbook).  None = the unchanged fp model.
+    quant: Optional[QuantSpec] = None
+    # backbone layout lever (docs/PERF.md "Quantized inference" —
+    # layout levers): zero-pad the stem's input channels 3 -> this many
+    # before conv0, aligning the channel axis for lane-friendly layouts.
+    # Padded channels are exactly zero so every conv sum is unchanged —
+    # output BIT-identical to the 3-channel model given the same first-3
+    # kernel channels (pinned by tests/test_quant.py); param shapes DO
+    # change (conv0 kernel grows an input channel), so this is a
+    # profile_step A/B lever (--pad_stem), not a checkpoint-compatible
+    # default.  0 = off.
+    stem_channel_pad: int = 0
 
     @property
     def num_anchors(self) -> int:
@@ -63,16 +79,18 @@ class FasterRCNN(nn.Module):
 
     def setup(self):
         if self.network == "vgg":
-            self.backbone = VGGBackbone(dtype=self.dtype)
-            self.head = VGGHead(dtype=self.dtype)
+            self.backbone = VGGBackbone(dtype=self.dtype, quant=self.quant)
+            self.head = VGGHead(dtype=self.dtype, quant=self.quant)
         elif self.network in ("resnet50", "resnet101"):
             depth = int(self.network.replace("resnet", ""))
-            self.backbone = ResNetBackbone(depth=depth, dtype=self.dtype)
-            self.head = ResNetHead(depth=depth, dtype=self.dtype)
+            self.backbone = ResNetBackbone(depth=depth, dtype=self.dtype,
+                                           quant=self.quant)
+            self.head = ResNetHead(depth=depth, dtype=self.dtype,
+                                   quant=self.quant)
         elif self.network == "tiny":  # test-only miniature (models/tiny.py)
             from mx_rcnn_tpu.models.tiny import TinyBackbone, TinyHead
-            self.backbone = TinyBackbone(dtype=self.dtype)
-            self.head = TinyHead(dtype=self.dtype)
+            self.backbone = TinyBackbone(dtype=self.dtype, quant=self.quant)
+            self.head = TinyHead(dtype=self.dtype, quant=self.quant)
         else:
             raise ValueError(f"unknown network {self.network!r}")
         head_out_init = nn.initializers.normal(0.01)
@@ -95,6 +113,9 @@ class FasterRCNN(nn.Module):
         raw uint8 (TPU-native path) — uint8 needs ``im_info`` so the
         on-device normalization masks padding back to exact zeros."""
         images = normalize_images(images, im_info, self.pixel_means)
+        pad = self.stem_channel_pad - images.shape[-1]
+        if pad > 0:  # layout lever: zero channels add exactly 0 per sum
+            images = jnp.pad(images, [(0, 0)] * 3 + [(0, pad)])
         return self.backbone(images)
 
     def rpn_raw(self, feat: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -213,12 +234,23 @@ class FasterRCNN(nn.Module):
         )
 
 
-def build_model(cfg: Config) -> FasterRCNN:
-    """Construct the model from a Config (ref generate_config wiring)."""
+def build_model(cfg: Config, quant_phase: str = "apply") -> FasterRCNN:
+    """Construct the model from a Config (ref generate_config wiring).
+
+    ``quant_phase`` only matters when ``cfg.quant.enabled``:
+    ``'apply'`` builds the quantized-inference model (needs the
+    calibrated ``quant`` variables collection — ``core/tester.py —
+    quant_predictor``), ``'calib'`` builds the statistics-recording
+    calibration twin.  With quant disabled (the default) the returned
+    model is the UNCHANGED fp model, bit-identical to a build that
+    predates the quant subsystem (pinned by tests/test_quant.py)."""
     from mx_rcnn_tpu.config import validate_dtype_string
+    from mx_rcnn_tpu.ops.quant import spec_from_config
 
     validate_dtype_string(cfg.network.compute_dtype,
                           "network__compute_dtype")
+    quant = (spec_from_config(cfg.quant, phase=quant_phase)
+             if cfg.quant.enabled else None)
     return FasterRCNN(
         network=cfg.network.name,
         num_classes=cfg.num_classes,
@@ -232,4 +264,6 @@ def build_model(cfg: Config) -> FasterRCNN:
         test_min_size=cfg.test.rpn_min_size,
         pixel_means=tuple(cfg.network.pixel_means),
         dtype=jnp.bfloat16 if cfg.network.compute_dtype == "bfloat16" else jnp.float32,
+        quant=quant,
+        stem_channel_pad=cfg.network.stem_channel_pad,
     )

@@ -14,6 +14,9 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
+
+from mx_rcnn_tpu.ops.quant import (QuantSpec, qconv, qdot, record_act_stats)
 
 Dtype = Any
 
@@ -55,16 +58,141 @@ def conv(
     use_bias: bool = True,
     padding: str | Sequence[Tuple[int, int]] = "SAME",
     kernel_init: Callable = nn.initializers.he_normal(),
-) -> nn.Conv:
-    """NHWC conv with fp32 params and configurable compute dtype."""
-    return nn.Conv(
-        features,
-        kernel,
-        strides=strides,
+    quant: Optional[QuantSpec] = None,
+) -> nn.Module:
+    """NHWC conv with fp32 params and configurable compute dtype.
+
+    ``quant=None`` (the default, and the only value when ``cfg.quant``
+    is disabled) returns plain ``nn.Conv`` — the fp path is the
+    UNCHANGED pre-quant module, bit-identical by construction and pinned
+    by ``tests/test_quant.py``.  A :class:`~mx_rcnn_tpu.ops.quant.
+    QuantSpec` swaps in :class:`QuantConv` with the SAME param
+    names/shapes, so fp32 checkpoints load into the quantized model
+    unmodified."""
+    if quant is None:
+        return nn.Conv(
+            features,
+            kernel,
+            strides=strides,
+            padding=padding,
+            use_bias=use_bias,
+            dtype=dtype,
+            param_dtype=jnp.float32,
+            kernel_init=kernel_init,
+            name=name,
+        )
+    return QuantConv(
+        features=features,
+        kernel_size=tuple(kernel),
+        strides=tuple(strides),
         padding=padding,
         use_bias=use_bias,
         dtype=dtype,
-        param_dtype=jnp.float32,
         kernel_init=kernel_init,
+        spec=quant,
         name=name,
     )
+
+
+def dense(
+    features: int,
+    dtype: Dtype = jnp.float32,
+    name: Optional[str] = None,
+    quant: Optional[QuantSpec] = None,
+    kernel_init: Callable = nn.initializers.lecun_normal(),
+) -> nn.Module:
+    """fp32-param Dense with the same quant swap-in as :func:`conv`."""
+    if quant is None:
+        return nn.Dense(features, dtype=dtype, param_dtype=jnp.float32,
+                        kernel_init=kernel_init, name=name)
+    return QuantDense(features=features, dtype=dtype,
+                      kernel_init=kernel_init, spec=quant, name=name)
+
+
+def _record_calib_stats(mod: nn.Module, x: jnp.ndarray,
+                        spec: QuantSpec) -> None:
+    """Create this layer's ``quant_stats`` slots and fold the batch's
+    activation statistics in (shared by QuantConv/QuantDense calib)."""
+    amax = mod.variable("quant_stats", "amax",
+                        lambda: jnp.zeros((), jnp.float32))
+    psum = mod.variable("quant_stats", "psum",
+                        lambda: jnp.zeros((), jnp.float32))
+    pcnt = mod.variable("quant_stats", "pcnt",
+                        lambda: jnp.zeros((), jnp.float32))
+    record_act_stats(amax, psum, pcnt, x, spec)
+
+
+class QuantConv(nn.Module):
+    """Inference-only quantized NHWC conv (docs/PERF.md "Quantized
+    inference"): fp32 ``kernel``/``bias`` params exactly like
+    ``nn.Conv`` (checkpoint-compatible), weights quantized per output
+    channel at trace time, input quantized per-tensor against the
+    calibrated ``act_scale`` (``quant`` variables collection), contracted
+    on the low-precision path (``ops/quant.qconv`` — int8→int32 native
+    or the fp32 fake-quant sim), rescaled once to fp32.
+
+    ``spec.phase='calib'`` instead runs the plain fp conv while
+    recording activation statistics into the mutable ``quant_stats``
+    collection (the calibration sweep — ``core/tester.py —
+    quant_predictor``)."""
+
+    features: int
+    kernel_size: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    use_bias: bool = True
+    dtype: Dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.he_normal()
+    spec: QuantSpec = QuantSpec()
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cin = x.shape[-1]
+        kernel = self.param("kernel", self.kernel_init,
+                            self.kernel_size + (cin, self.features),
+                            jnp.float32)
+        bias = (self.param("bias", nn.initializers.zeros,
+                           (self.features,), jnp.float32)
+                if self.use_bias else None)
+        if self.spec.phase == "calib":
+            _record_calib_stats(self, x, self.spec)
+            y = lax.conv_general_dilated(
+                x.astype(self.dtype), kernel.astype(self.dtype),
+                tuple(self.strides), self.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        else:
+            act_scale = self.variable(
+                "quant", "act_scale",
+                lambda: jnp.ones((), jnp.float32)).value
+            y = qconv(x, kernel, act_scale, self.spec,
+                      tuple(self.strides), self.padding)
+        if bias is not None:
+            y = y + bias.astype(y.dtype)
+        return y.astype(self.dtype)
+
+
+class QuantDense(nn.Module):
+    """Inference-only quantized Dense — the :class:`QuantConv` contract
+    for (…, K) @ (K, N) contractions (``ops/quant.qdot``)."""
+
+    features: int
+    dtype: Dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    spec: QuantSpec = QuantSpec()
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        k = x.shape[-1]
+        kernel = self.param("kernel", self.kernel_init,
+                            (k, self.features), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), jnp.float32)
+        if self.spec.phase == "calib":
+            _record_calib_stats(self, x, self.spec)
+            y = x.astype(self.dtype) @ kernel.astype(self.dtype)
+        else:
+            act_scale = self.variable(
+                "quant", "act_scale",
+                lambda: jnp.ones((), jnp.float32)).value
+            y = qdot(x, kernel, act_scale, self.spec)
+        return (y + bias.astype(y.dtype)).astype(self.dtype)

@@ -14,12 +14,13 @@ fp32, compute dtype configurable (bf16 for the MXU).
 
 from __future__ import annotations
 
-from typing import Any, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
 
 from mx_rcnn_tpu.models.layers import FrozenBatchNorm, conv
+from mx_rcnn_tpu.ops.quant import QuantSpec
 
 Dtype = Any
 
@@ -41,15 +42,19 @@ class BottleneckUnit(nn.Module):
     stride: int = 1
     dim_match: bool = True
     dtype: Dtype = jnp.float32
+    # inference-only quantization recipe (ops/quant.py); None = the
+    # unchanged fp path (bit-identical, pinned by tests/test_quant.py)
+    quant: Optional[QuantSpec] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         act1 = nn.relu(FrozenBatchNorm(dtype=self.dtype, name="bn1")(x))
         c1 = conv(self.filters // 4, (1, 1), dtype=self.dtype, use_bias=False,
-                  name="conv1")(act1)
+                  name="conv1", quant=self.quant)(act1)
         act2 = nn.relu(FrozenBatchNorm(dtype=self.dtype, name="bn2")(c1))
         c2 = conv(self.filters // 4, (3, 3), (self.stride, self.stride),
-                  dtype=self.dtype, use_bias=False, name="conv2")(act2)
+                  dtype=self.dtype, use_bias=False, name="conv2",
+                  quant=self.quant)(act2)
         act3 = nn.relu(FrozenBatchNorm(dtype=self.dtype, name="bn3")(c2))
         # zero-init the residual branch output: with frozen identity BN a
         # he-init conv3 doubles activation variance per unit (2^33 by the end
@@ -58,23 +63,27 @@ class BottleneckUnit(nn.Module):
         # weights with real BN statistics; zero init makes random init sane
         # and is overwritten anyway when pretrained weights load.
         c3 = conv(self.filters, (1, 1), dtype=self.dtype, use_bias=False,
-                  kernel_init=nn.initializers.zeros, name="conv3")(act3)
+                  kernel_init=nn.initializers.zeros, name="conv3",
+                  quant=self.quant)(act3)
         if self.dim_match:
             shortcut = x
         else:
             shortcut = conv(self.filters, (1, 1), (self.stride, self.stride),
-                            dtype=self.dtype, use_bias=False, name="sc")(act1)
+                            dtype=self.dtype, use_bias=False, name="sc",
+                            quant=self.quant)(act1)
         return c3 + shortcut
 
 
 def _stage(x: jnp.ndarray, filters: int, units: int, stride: int,
-           dtype: Dtype, name_prefix: str) -> jnp.ndarray:
+           dtype: Dtype, name_prefix: str,
+           quant: Optional[QuantSpec] = None) -> jnp.ndarray:
     for u in range(units):
         x = BottleneckUnit(
             filters=filters,
             stride=stride if u == 0 else 1,
             dim_match=False if u == 0 else True,
             dtype=dtype,
+            quant=quant,
             name=f"{name_prefix}_unit{u + 1}",
         )(x)
     return x
@@ -89,6 +98,7 @@ class ResNetBackbone(nn.Module):
 
     depth: int = 101
     dtype: Dtype = jnp.float32
+    quant: Optional[QuantSpec] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -97,12 +107,12 @@ class ResNetBackbone(nn.Module):
         # ref: bn_data (BatchNorm on raw input, fix_gamma=True)
         x = FrozenBatchNorm(dtype=self.dtype, name="bn_data")(x)
         x = conv(64, (7, 7), (2, 2), dtype=self.dtype, use_bias=False,
-                 name="conv0")(x)
+                 name="conv0", quant=self.quant)(x)
         x = nn.relu(FrozenBatchNorm(dtype=self.dtype, name="bn0")(x))
         x = nn.max_pool(x, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
-        x = _stage(x, 256, units[0], 1, self.dtype, "stage1")
-        x = _stage(x, 512, units[1], 2, self.dtype, "stage2")
-        x = _stage(x, 1024, units[2], 2, self.dtype, "stage3")
+        x = _stage(x, 256, units[0], 1, self.dtype, "stage1", self.quant)
+        x = _stage(x, 512, units[1], 2, self.dtype, "stage2", self.quant)
+        x = _stage(x, 1024, units[2], 2, self.dtype, "stage3", self.quant)
         return x
 
 
@@ -113,12 +123,13 @@ class ResNetHead(nn.Module):
 
     depth: int = 101
     dtype: Dtype = jnp.float32
+    quant: Optional[QuantSpec] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         units = STAGE_UNITS[self.depth]
         x = x.astype(self.dtype)
-        x = _stage(x, 2048, units[3], 2, self.dtype, "stage4")
+        x = _stage(x, 2048, units[3], 2, self.dtype, "stage4", self.quant)
         # ref: bn1 + relu1 + global pool close the v2-style network
         x = nn.relu(FrozenBatchNorm(dtype=self.dtype, name="bn1")(x))
         return jnp.mean(x, axis=(1, 2))  # (R, 2048)

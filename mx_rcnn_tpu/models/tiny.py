@@ -10,12 +10,13 @@ networks, so pipeline bugs cannot hide behind model size.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
 
-from mx_rcnn_tpu.models.layers import conv
+from mx_rcnn_tpu.models.layers import conv, dense
+from mx_rcnn_tpu.ops.quant import QuantSpec
 
 Dtype = Any
 
@@ -24,12 +25,17 @@ class TinyBackbone(nn.Module):
     """Two strided convs → stride 16, 32 channels."""
 
     dtype: Dtype = jnp.float32
+    # inference-only quantization recipe (ops/quant.py); None = the
+    # unchanged fp path (bit-identical, pinned by tests/test_quant.py)
+    quant: Optional[QuantSpec] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         x = x.astype(self.dtype)
-        x = nn.relu(conv(16, (5, 5), (4, 4), dtype=self.dtype, name="conv1")(x))
-        x = nn.relu(conv(32, (3, 3), (4, 4), dtype=self.dtype, name="conv2")(x))
+        x = nn.relu(conv(16, (5, 5), (4, 4), dtype=self.dtype, name="conv1",
+                         quant=self.quant)(x))
+        x = nn.relu(conv(32, (3, 3), (4, 4), dtype=self.dtype, name="conv2",
+                         quant=self.quant)(x))
         return x
 
 
@@ -37,10 +43,11 @@ class TinyHead(nn.Module):
     """Flatten → 64-unit dense."""
 
     dtype: Dtype = jnp.float32
+    quant: Optional[QuantSpec] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         r = x.shape[0]
         x = x.astype(self.dtype).reshape(r, -1)
-        return nn.relu(nn.Dense(64, dtype=self.dtype, param_dtype=jnp.float32,
-                                name="fc")(x))
+        return nn.relu(dense(64, dtype=self.dtype, name="fc",
+                             quant=self.quant)(x))

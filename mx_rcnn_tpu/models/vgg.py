@@ -9,12 +9,13 @@ the reference.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
 
-from mx_rcnn_tpu.models.layers import conv
+from mx_rcnn_tpu.models.layers import conv, dense
+from mx_rcnn_tpu.ops.quant import QuantSpec
 
 Dtype = Any
 
@@ -32,6 +33,9 @@ class VGGBackbone(nn.Module):
     """Shared conv1–conv5 features, stride 16 (ref ``get_vgg_conv``)."""
 
     dtype: Dtype = jnp.float32
+    # inference-only quantization recipe (ops/quant.py); None = the
+    # unchanged fp path (bit-identical, pinned by tests/test_quant.py)
+    quant: Optional[QuantSpec] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -39,7 +43,8 @@ class VGGBackbone(nn.Module):
         for i, (name, n_convs, filters) in enumerate(_VGG16_BLOCKS):
             for j in range(n_convs):
                 x = nn.relu(
-                    conv(filters, (3, 3), dtype=self.dtype, name=f"{name}_{j + 1}")(x)
+                    conv(filters, (3, 3), dtype=self.dtype,
+                         name=f"{name}_{j + 1}", quant=self.quant)(x)
                 )
             if i < 4:  # no pool5 — conv5_3 stays at stride 16
                 x = nn.max_pool(x, (2, 2), (2, 2))
@@ -52,15 +57,16 @@ class VGGHead(nn.Module):
 
     dtype: Dtype = jnp.float32
     dropout_rate: float = 0.5
+    quant: Optional[QuantSpec] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         r = x.shape[0]
         x = x.astype(self.dtype).reshape(r, -1)
-        x = nn.relu(nn.Dense(4096, dtype=self.dtype, param_dtype=jnp.float32,
-                             name="fc6")(x))
+        x = nn.relu(dense(4096, dtype=self.dtype, name="fc6",
+                          quant=self.quant)(x))
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        x = nn.relu(nn.Dense(4096, dtype=self.dtype, param_dtype=jnp.float32,
-                             name="fc7")(x))
+        x = nn.relu(dense(4096, dtype=self.dtype, name="fc7",
+                          quant=self.quant)(x))
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         return x  # (R, 4096)

@@ -1,0 +1,307 @@
+"""Post-training quantization for the INFERENCE forward path (int8 / fp8).
+
+No reference equivalent — the reference serves fp32.  This module applies
+the standard post-training-quantization playbook (Jacob et al. 2018,
+"Quantization and Training of Neural Networks for Efficient
+Integer-Arithmetic-Only Inference") to the Faster R-CNN serving forward
+(docs/PERF.md "Quantized inference"):
+
+* **weights**: per-output-channel symmetric quantization (zero-point 0),
+  scale = absmax / qmax per channel, computed from the fp32 checkpoint at
+  trace time — no weight rewriting, checkpoints stay fp32 and load into
+  the quantized model unchanged (same param names/shapes);
+* **activations**: per-tensor symmetric quantization with scales from an
+  offline calibration sweep over a held-out batch set
+  (:func:`finalize_calibration` — ``absmax`` and ``percentile``
+  estimators, both DETERMINISTIC given the calibration set: the sweep is
+  pure jnp over a fixed batch order, pinned by ``tests/test_quant.py``);
+* **two execution paths** behind ``QuantSpec.mode``:
+  ``'sim'`` runs the quantized *integer values* in fp32 arithmetic (the
+  fake-quant simulation — runs anywhere, including this CPU box), and
+  ``'native'`` runs the real low-precision program: int8×int8 →
+  **int32-accumulate** ``lax.dot_general`` / ``lax.conv_general_dilated``
+  (fp8 e4m3 → fp32-accumulate), with ONE fp32 rescale at the end.  The
+  two paths compute the same real-arithmetic value; they are pinned
+  BIT-EQUAL at the tile level (contraction sizes where fp32 accumulation
+  of integer products is exact, i.e. count·qmax² < 2²⁴) and allclose
+  beyond it — the sim path is therefore a faithful accuracy proxy for
+  the native program, which is what the gauntlet accuracy gate runs.
+
+Quantized layers cover the backbone convs and the per-ROI head trunk
+(``models/layers.py — QuantConv/QuantDense``); the RPN head and the
+final ``cls_score``/``bbox_pred`` projections stay fp (first/last-layer
+exemption, per the PTQ playbook).  The whole subsystem is OFF by default
+and the fp path is bit-identical to a build without it (pinned by test).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Any, Dict, Mapping, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_DTYPES = ("int8", "fp8")
+_MODES = ("native", "sim")
+_ESTIMATORS = ("absmax", "percentile")
+_PHASES = ("apply", "calib")
+
+# fp8 e4m3fn dynamic range (finite max); values are clipped here BEFORE
+# the cast — e4m3fn saturates overflow to NaN, not to the max finite
+FP8_MAX = 448.0
+
+# keys a calibration-stats node carries (one per quantized layer)
+_STAT_KEYS = frozenset({"amax", "psum", "pcnt"})
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantSpec:
+    """Static quantization recipe, threaded through the model modules as
+    a flax field (frozen + hashable so module comparison/jit keying
+    work).  Built from ``cfg.quant`` by ``models.build_model``."""
+
+    dtype: str = "int8"        # 'int8' | 'fp8' (e4m3)
+    mode: str = "native"       # 'native' low-precision program | 'sim'
+    estimator: str = "absmax"  # activation-scale estimator
+    percentile: float = 99.9   # for estimator='percentile'
+    # effective integer bits for the int8 container, SHARED by the
+    # weight channels and the activation grid (qmax = 2^(b-1)-1 for
+    # both); 8 = production, lower values are the red-team
+    # over-quantization arm the accuracy gate must catch
+    # (tools/gauntlet.py quant_redteam)
+    weight_bits: int = 8
+    # 'apply' runs quantized; 'calib' runs the fp forward while
+    # recording activation statistics into the 'quant_stats' collection
+    phase: str = "apply"
+
+    def __post_init__(self):
+        if self.dtype not in _DTYPES:
+            raise ValueError(f"quant dtype must be one of {_DTYPES}, "
+                             f"got {self.dtype!r}")
+        if self.mode not in _MODES:
+            raise ValueError(f"quant mode must be one of {_MODES}, "
+                             f"got {self.mode!r}")
+        if self.estimator not in _ESTIMATORS:
+            raise ValueError(f"quant estimator must be one of "
+                             f"{_ESTIMATORS}, got {self.estimator!r}")
+        if self.phase not in _PHASES:
+            raise ValueError(f"quant phase must be one of {_PHASES}, "
+                             f"got {self.phase!r}")
+        if not 2 <= self.weight_bits <= 8:
+            raise ValueError(f"quant weight_bits must be in [2, 8], "
+                             f"got {self.weight_bits}")
+        if self.dtype == "fp8" and self.weight_bits != 8:
+            # qmax for fp8 is the format's own max — narrowing
+            # weight_bits would be silently ignored, turning e.g. the
+            # red-team over-quantization arm into a full-precision no-op
+            raise ValueError("weight_bits only narrows the int8 "
+                             "container; use dtype='int8' with "
+                             f"weight_bits={self.weight_bits}")
+
+    @property
+    def qmax(self) -> float:
+        """Largest representable magnitude of the quantized container."""
+        if self.dtype == "fp8":
+            return FP8_MAX
+        return float(2 ** (self.weight_bits - 1) - 1)
+
+
+def spec_from_config(qcfg, phase: str = "apply") -> QuantSpec:
+    """``cfg.quant`` → :class:`QuantSpec` (validates every knob)."""
+    return QuantSpec(dtype=qcfg.dtype, mode=qcfg.mode,
+                     estimator=qcfg.estimator, percentile=qcfg.percentile,
+                     weight_bits=qcfg.weight_bits, phase=phase)
+
+
+# ---------------------------------------------------------------------------
+# quantize / dequantize primitives
+# ---------------------------------------------------------------------------
+
+def _unit(est: jnp.ndarray, qmax: float) -> jnp.ndarray:
+    """Quantization step size from an absmax-style estimate; floored so
+    an all-zero channel/tensor divides by a representable epsilon
+    instead of 0 (its quantized values are exactly 0 either way)."""
+    return jnp.maximum(est.astype(jnp.float32), 1e-12) / qmax
+
+
+def _quantize(x: jnp.ndarray, unit: jnp.ndarray, spec: QuantSpec
+              ) -> jnp.ndarray:
+    """Shared container mapping for weights and activations: scale by
+    ``unit`` then clip/round/cast into the spec's container."""
+    if spec.dtype == "fp8":
+        return jnp.clip(x / unit, -FP8_MAX, FP8_MAX).astype(
+            jnp.float8_e4m3fn)
+    q = jnp.clip(jnp.round(x / unit), -spec.qmax, spec.qmax)
+    return q.astype(jnp.int8 if spec.mode == "native" else jnp.float32)
+
+
+def quantize_weight(w: jnp.ndarray, spec: QuantSpec
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-output-channel symmetric weight quantization.  ``w`` is an
+    fp32 kernel whose LAST axis is the output-channel axis (HWIO conv
+    kernels and (K, N) dense kernels both satisfy this).  Returns
+    ``(q, unit)`` with ``unit`` shaped (out_channels,):
+    dequantized = q * unit."""
+    w = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
+    unit = _unit(absmax, spec.qmax)
+    return _quantize(w, unit, spec), unit
+
+
+def quantize_act(x: jnp.ndarray, est: jnp.ndarray, spec: QuantSpec
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-tensor symmetric activation quantization against the
+    calibrated scale estimate ``est`` (a scalar from
+    :func:`finalize_calibration`).  Returns ``(q, unit)``."""
+    x = x.astype(jnp.float32)
+    unit = _unit(est, spec.qmax)
+    return _quantize(x, unit, spec), unit
+
+
+def fake_quant(x: jnp.ndarray, est: jnp.ndarray, spec: QuantSpec
+               ) -> jnp.ndarray:
+    """Quantize-dequantize round trip (the classic fake-quant op):
+    returns the fp32 values the quantized representation can express."""
+    q, unit = quantize_act(x, est, spec)
+    return q.astype(jnp.float32) * unit
+
+
+# ---------------------------------------------------------------------------
+# quantized contractions (the sim / native pair)
+# ---------------------------------------------------------------------------
+
+def _accum(qx: jnp.ndarray, qw: jnp.ndarray, spec: QuantSpec, conv_kw=None):
+    """The low-precision contraction, one implementation per container:
+    int8 native accumulates in **int32** (exact integer arithmetic); the
+    sim path and fp8 accumulate in fp32.  ``conv_kw`` switches dot →
+    conv."""
+    if spec.dtype == "fp8" or spec.mode != "native":
+        acc_t = jnp.float32
+        if spec.dtype != "fp8":
+            # sim: integer values carried in fp32 — keep them as-is
+            qx, qw = qx.astype(jnp.float32), qw.astype(jnp.float32)
+    else:
+        acc_t = jnp.int32
+    if conv_kw is None:
+        y = lax.dot_general(qx, qw, (((qx.ndim - 1,), (0,)), ((), ())),
+                            preferred_element_type=acc_t)
+    else:
+        y = lax.conv_general_dilated(
+            qx, qw, conv_kw["strides"], conv_kw["padding"],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=acc_t)
+    return y.astype(jnp.float32)
+
+
+def qdot(x: jnp.ndarray, w: jnp.ndarray, act_est: jnp.ndarray,
+         spec: QuantSpec) -> jnp.ndarray:
+    """Quantized dense contraction: ``x (..., K) @ w (K, N) → fp32``.
+    Real-arithmetic value = (qx·qw) · x_unit · w_unit[n]; the sim and
+    native paths differ only in the accumulator (module docstring)."""
+    qw, w_unit = quantize_weight(w, spec)
+    qx, x_unit = quantize_act(x, act_est, spec)
+    return _accum(qx, qw, spec) * (x_unit * w_unit)
+
+
+def qconv(x: jnp.ndarray, kernel: jnp.ndarray, act_est: jnp.ndarray,
+          spec: QuantSpec, strides: Tuple[int, int],
+          padding) -> jnp.ndarray:
+    """Quantized NHWC conv with an HWIO kernel → fp32.  Same contract as
+    :func:`qdot` (per-output-channel weight units broadcast over the
+    channel axis)."""
+    qw, w_unit = quantize_weight(kernel, spec)
+    qx, x_unit = quantize_act(x, act_est, spec)
+    y = _accum(qx, qw, spec,
+               conv_kw={"strides": tuple(strides), "padding": padding})
+    return y * (x_unit * w_unit)
+
+
+# ---------------------------------------------------------------------------
+# calibration: stats sweep → activation scales → fingerprint
+# ---------------------------------------------------------------------------
+
+def record_act_stats(amax, psum, pcnt, x: jnp.ndarray,
+                     spec: QuantSpec) -> None:
+    """Update one layer's calibration accumulators (flax variables in
+    the mutable ``quant_stats`` collection) from one calibration batch:
+    running max of |x| plus the running sum/count of the per-batch
+    ``spec.percentile`` of |x| — both estimators are always collected so
+    the estimator choice is a finalize-time decision."""
+    ax = jnp.abs(x.astype(jnp.float32))
+    amax.value = jnp.maximum(amax.value, jnp.max(ax))
+    psum.value = psum.value + jnp.percentile(ax, spec.percentile)
+    pcnt.value = pcnt.value + 1.0
+
+
+def finalize_calibration(stats, qcfg) -> Dict:
+    """``quant_stats`` collection (from the calibration sweep) → the
+    ``quant`` variables collection the apply-phase model reads: each
+    layer's ``{amax, psum, pcnt}`` node becomes ``{act_scale}`` under
+    the configured estimator.  Pure function of the stats — the
+    determinism contract (same calibration set ⇒ identical scales) is
+    pinned by ``tests/test_quant.py``."""
+    def walk(node):
+        if isinstance(node, Mapping) and _STAT_KEYS <= set(node):
+            if qcfg.estimator == "percentile":
+                est = node["psum"] / jnp.maximum(node["pcnt"], 1.0)
+            else:
+                est = node["amax"]
+            return {"act_scale": jnp.asarray(est, jnp.float32)}
+        if isinstance(node, Mapping):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+    return walk(_unfreeze(stats))
+
+
+def _unfreeze(tree):
+    try:
+        from flax.core import unfreeze
+
+        return unfreeze(tree)
+    except Exception:
+        return tree
+
+
+def calibration_fingerprint(quant_col, qcfg) -> str:
+    """Stable sha256-derived fingerprint of a calibration result: the
+    quant knobs (dtype/estimator/percentile/weight_bits) plus every
+    activation scale's path and exact bytes.  Two processes that
+    calibrated identically agree; ANY drift (different calibration set,
+    estimator, damage arm) disagrees — the export-store admission token
+    (``serve/export.py``)."""
+    h = hashlib.sha256()
+    h.update(repr((qcfg.dtype, qcfg.estimator, float(qcfg.percentile),
+                   int(qcfg.weight_bits))).encode())
+    leaves = jax.tree_util.tree_flatten_with_path(quant_col)[0]
+    for path, leaf in sorted(leaves, key=lambda kv: jax.tree_util.keystr(
+            kv[0])):
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(np.asarray(leaf, np.float32).tobytes())
+    return h.hexdigest()[:16]
+
+
+def quant_program_tag(qcfg, fingerprint: str) -> str:
+    """The program-cache / manifest tag that keeps quantized and fp
+    programs un-mixable: a ``Predictor`` in quant mode prefixes every
+    program key with this (``core/tester.py``), and the export-store
+    manifest records the same fields (``serve/export.py``)."""
+    return (f"quant[{qcfg.dtype}:{qcfg.mode}:{qcfg.estimator}"
+            f":b{qcfg.weight_bits}:{fingerprint}]")
+
+
+def quant_manifest_meta(qcfg, fingerprint: str) -> Dict[str, Any]:
+    """The quant knobs an export-store manifest records — the admission
+    contract: a replica whose own knobs (INCLUDING its locally derived
+    calibration fingerprint) disagree must refuse the store."""
+    return {
+        "dtype": qcfg.dtype,
+        "mode": qcfg.mode,
+        "estimator": qcfg.estimator,
+        "percentile": float(qcfg.percentile),
+        "weight_bits": int(qcfg.weight_bits),
+        "calibration_fingerprint": fingerprint,
+    }

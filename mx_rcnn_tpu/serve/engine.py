@@ -342,7 +342,12 @@ class ServingEngine:
         from mx_rcnn_tpu.serve.export import SERVE_POST, serve_fwd_name
 
         t0 = time.monotonic()
-        store.check(self.cfg)
+        # quant admission: the store's recorded quant knobs (incl. the
+        # calibration fingerprint) must equal this predictor's — an fp
+        # replica can never install quantized programs or vice versa
+        store.check(self.cfg,
+                    quant_fingerprint=getattr(self.predictor,
+                                              "quant_fingerprint", None))
         n = self.cfg.serve.batch_size
         for bucket in self.buckets:
             bh, bw = bucket

@@ -196,12 +196,22 @@ class ExportStore:
     def cache_dir(self) -> str:
         return os.path.join(self.root, CACHE_SUBDIR)
 
-    def check(self, cfg, allow_mismatch: bool = False) -> Dict:
+    def check(self, cfg, allow_mismatch: bool = False,
+              quant_fingerprint: str = None) -> Dict:
         """Admission check before any program loads: config fingerprint,
         bucket shapes and jax version must match this process, else the
         store serves different semantics than a live trace would —
         refuse (``ExportMismatch``) unless ``allow_mismatch`` downgrades
-        to a WARNING (debugging only)."""
+        to a WARNING (debugging only).
+
+        ``quant_fingerprint``: the loading process's OWN calibration
+        fingerprint (``Predictor.quant_fingerprint``; None when
+        ``cfg.quant`` is off).  The manifest's recorded quant knobs —
+        dtype/mode/estimator/weight_bits AND the calibration
+        fingerprint — must agree exactly: a quantized store can never
+        warm an fp replica, an fp store can never warm a quantized one,
+        and two differently-calibrated quant processes can never share
+        programs (docs/SERVING.md "Quantized exports")."""
         import jax
 
         from mx_rcnn_tpu.utils.checkpoint import config_fingerprint
@@ -231,6 +241,22 @@ class ExportStore:
                           ("num_classes", cfg.num_classes)):
             if key in m and m[key] != live:
                 problems.append(f"{key} {m[key]} != this run's {live}")
+        # quantization admission (docs/PERF.md "Quantized inference"):
+        # the recorded quant block must equal this process's — None vs
+        # None for fp, or every knob INCLUDING the calibration
+        # fingerprint for quant.  Old manifests without the key count
+        # as fp stores.
+        recorded = m.get("quant")
+        if getattr(cfg, "quant", None) is not None and cfg.quant.enabled:
+            from mx_rcnn_tpu.ops.quant import quant_manifest_meta
+
+            live_q = quant_manifest_meta(cfg.quant, quant_fingerprint)
+        else:
+            live_q = None
+        if recorded != live_q:
+            problems.append(
+                f"quant knobs {recorded} != this run's {live_q} — "
+                "quantized and fp programs must never mix unknowingly")
         if problems:
             msg = (f"export store {self.root} does not match this "
                    f"process: " + "; ".join(problems))
@@ -312,12 +338,22 @@ def export_serve_programs(predictor, cfg, root: str, *,
     variables = predictor.variables
     n = cfg.serve.batch_size
     buckets = [tuple(b) for b in cfg.bucket.shapes]
+    # quant block (admission contract — see ExportStore.check): a
+    # quantized predictor's programs carry its recipe + calibration
+    # fingerprint in the manifest; fp stores record None explicitly
+    quant_meta = None
+    if cfg.quant.enabled:
+        from mx_rcnn_tpu.ops.quant import quant_manifest_meta
+
+        quant_meta = quant_manifest_meta(cfg.quant,
+                                         predictor.quant_fingerprint)
     store = ExportStore.create(
         root, cfg, extra_meta={
             "serve_batch_size": n,
             "eval_batch_size": eval_batch,
             "nms_thresh": cfg.test.nms,
             "serve_score_thresh": cfg.serve.score_thresh,
+            "quant": quant_meta,
         })
     report: Dict = {"root": root, "programs": [], "verified": verify,
                     "bit_equal": None}

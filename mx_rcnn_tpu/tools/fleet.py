@@ -167,6 +167,17 @@ def cmd_serve(args) -> int:
             model, jax.random.PRNGKey(args.seed),
             (1,) + tuple(cfg.bucket.shapes[0]) + (3,))
     variables = {"params": params, "batch_stats": batch_stats}
+    if cfg.quant.enabled:
+        # quantized fleet (docs/PERF.md "Quantized inference"): every
+        # replica's Predictor is built from these shared variables, so
+        # the calibration sweep runs ONCE here; the export-store
+        # admission check (serve/export.py) refuses a store whose quant
+        # knobs/fingerprint disagree with what this derives
+        from mx_rcnn_tpu.core.tester import calibrate_quant
+
+        variables["quant"] = calibrate_quant(cfg, params, batch_stats)
+        logger.info("quant fleet: %s/%s calibrated", cfg.quant.dtype,
+                    cfg.quant.mode)
     logger.info("launching %d replica(s), %s ...", cfg.fleet.replicas,
                 f"export-warm from {export_dir}" if export_dir
                 else "trace-warm")

@@ -20,6 +20,16 @@ Modes:
   pair (VERDICT r5 weak #4) — training is bit-identical to ``e2e`` at a
   common seed, so the per-seed deltas isolate pure eval damage.  Never a
   recipe; a gate self-test (docs/GAUNTLET.md "Red-team").
+* ``quant``     — e2e trained normally (fp, bit-identical to ``e2e`` at a
+  common seed) but EVALUATED through the quantized inference forward
+  (``cfg.quant`` int8 by default; docs/PERF.md "Quantized inference").
+  ``--compare e2e quant`` is the quantization accuracy gate: the paired
+  mAP delta must stay within ``--budget``.
+* ``quant_redteam`` — the over-aggressive-quantization arm (weight_bits
+  2 narrows the shared int8 container: weights collapse to ±1 step and
+  the activation grid coarsens to match) proving the quant gate's FAIL
+  direction fires; never a recipe (``make quant-smoke`` runs the fast
+  twin).
 
 Each run appends a record to ``--out`` (JSON) keyed by
 (mode, network, seed); ``--markdown`` re-renders every record into a docs
@@ -46,11 +56,20 @@ import numpy as np
 
 logger = logging.getLogger("mx_rcnn_tpu")
 
-_MODES = ("e2e", "alternate", "prenms", "redteam")
+_MODES = ("e2e", "alternate", "prenms", "redteam", "quant",
+          "quant_redteam")
 
-# the red-team arm's damage, in one place so the record, the docstring
+# the red-team arms' damage, in one place so the record, the docstring
 # and the test pin the same thing
 _REDTEAM_NMS = 0.9
+_QUANT_REDTEAM_BITS = 2
+
+
+def _quant_tag(cfg) -> str:
+    """Compact quant-recipe tag recorded with every quant-mode record so
+    mixed quant recipes surface in summaries (see ``_recipe_str``)."""
+    return (f"{cfg.quant.dtype}/{cfg.quant.mode}/{cfg.quant.estimator}/"
+            f"b{cfg.quant.weight_bits}")
 
 
 def _base_cfg(args):
@@ -72,16 +91,31 @@ def run_one(args, mode: str, seed: int) -> Dict:
     from mx_rcnn_tpu.tools.train_alternate import alternate_train
 
     cfg = _base_cfg(args)
+    eval_cfg = cfg
     if mode == "prenms":
         # the production claim is 12000->6000 at 608x1024 (21 888 anchors,
         # keep ~27%); at this canvas (2700 anchors) every cap >= 2700 is
         # vacuous, so the ablation uses --prenms_n (default: the
         # proportional ~27% analog) to actually bite
-        cfg = cfg.replace_in("train", rpn_pre_nms_top_n=args.prenms_n)
+        cfg = eval_cfg = cfg.replace_in("train",
+                                        rpn_pre_nms_top_n=args.prenms_n)
     elif mode == "redteam":
         # deliberately damaged EVAL arm (module docstring): duplicate
-        # boxes survive per-class NMS and land as false positives
-        cfg = cfg.replace_in("test", nms=_REDTEAM_NMS)
+        # boxes survive per-class NMS and land as false positives —
+        # training cfg stays untouched (bit-identical to e2e per seed)
+        eval_cfg = cfg.replace_in("test", nms=_REDTEAM_NMS)
+    elif mode == "quant":
+        # quantized EVAL arm (training stays fp/bit-identical to e2e —
+        # only eval_cfg flips the switch; test_rcnn calibrates + swaps
+        # in the quant predictor when it sees quant.enabled) — per-seed
+        # deltas vs e2e isolate pure quantization error
+        eval_cfg = cfg.replace_in("quant", enabled=True)
+    elif mode == "quant_redteam":
+        # over-aggressive quantization (module docstring): 2-bit weights
+        # collapse every channel to one magnitude step — the quant gate
+        # must fire on this arm
+        eval_cfg = cfg.replace_in("quant", enabled=True,
+                                  weight_bits=_QUANT_REDTEAM_BITS)
     prefix = os.path.join(args.workdir, f"{mode}-{args.network}-s{seed}")
     os.makedirs(os.path.dirname(prefix), exist_ok=True)
     if mode == "alternate":
@@ -102,7 +136,7 @@ def run_one(args, mode: str, seed: int) -> Dict:
                   lr_step=args.lr_step or str(max(1, args.epochs - 6)),
                   frequent=10_000, seed=seed)
         eval_prefix, eval_epoch = prefix, args.epochs
-    results = eval_rcnn(cfg, prefix=eval_prefix, epoch=eval_epoch,
+    results = eval_rcnn(eval_cfg, prefix=eval_prefix, epoch=eval_epoch,
                         verbose=False)
     rec = {
         "mode": mode, "network": args.network, "seed": seed,
@@ -116,6 +150,11 @@ def run_one(args, mode: str, seed: int) -> Dict:
         rec["prenms_n"] = args.prenms_n
     elif mode == "redteam":
         rec["damage"] = f"test__nms={_REDTEAM_NMS}"
+    elif mode == "quant":
+        rec["quant"] = _quant_tag(eval_cfg)
+    elif mode == "quant_redteam":
+        rec["damage"] = f"quant__weight_bits={_QUANT_REDTEAM_BITS}"
+        rec["quant"] = _quant_tag(eval_cfg)
     return rec
 
 
@@ -137,6 +176,8 @@ def _recipe_str(r: Dict) -> str:
          f"/step{r.get('lr_step') or 'auto'}/bi{r.get('batch_images', '?')}")
     if r.get("mode") == "prenms":
         s += f"/pre{r.get('prenms_n', '?')}"
+    if "quant" in r:
+        s += f"/q:{r['quant']}"
     return s
 
 

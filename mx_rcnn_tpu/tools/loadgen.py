@@ -94,7 +94,11 @@ def synthetic_images(cfg: Config, n: int, seed: int = 0
 def init_predictor(cfg: Config, prefix: str = None, epoch: int = 0,
                    seed: int = 0) -> Predictor:
     """Predictor from a checkpoint when given one, else from random init
-    — serving throughput does not depend on the weight values."""
+    — serving throughput does not depend on the weight values.  With
+    ``cfg.quant.enabled`` the returned predictor is the quantized one
+    (held-out calibration sweep + tagged program keys — docs/PERF.md
+    "Quantized inference"), so every serving CLI gains the quant mode
+    through one ``--set quant__enabled=true``."""
     import jax
 
     from mx_rcnn_tpu.core.train import init_variables
@@ -108,6 +112,10 @@ def init_predictor(cfg: Config, prefix: str = None, epoch: int = 0,
         params, batch_stats = init_variables(
             model, jax.random.PRNGKey(seed),
             (1,) + tuple(cfg.bucket.shapes[0]) + (3,))
+    if cfg.quant.enabled:
+        from mx_rcnn_tpu.core.tester import quant_predictor
+
+        return quant_predictor(cfg, params, batch_stats)
     return Predictor(model, {"params": params, "batch_stats": batch_stats},
                      cfg)
 

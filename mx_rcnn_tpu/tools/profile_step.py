@@ -125,6 +125,23 @@ def main(argv=None) -> None:
                         "recompiles on every timed pass, and the stage "
                         "gauges landing in the obs registry; exits "
                         "non-zero on violation")
+    p.add_argument("--quant", action="store_true",
+                   help="ALSO time the quantized INFERENCE forward vs "
+                        "the fp one per bucket (docs/PERF.md 'Quantized "
+                        "inference'): calibrates on the synthetic batch, "
+                        "then chains the full test-mode forward in both "
+                        "arms — the r9 quant A/B switch")
+    p.add_argument("--quant_dtype", default="int8",
+                   choices=("int8", "fp8"))
+    p.add_argument("--quant_mode", default="native",
+                   choices=("native", "sim"))
+    p.add_argument("--pad_stem", type=int, default=0,
+                   help="backbone layout lever: zero-pad the stem's "
+                        "input channels 3 -> N before conv0 "
+                        "(cfg.network.stem_channel_pad; output pinned "
+                        "bit-identical, param shapes change).  The A/B "
+                        "is two invocations, 0 vs 4, like the other "
+                        "lever switches")
     args = p.parse_args(argv)
 
     import jax
@@ -151,6 +168,8 @@ def main(argv=None) -> None:
                          nms_batched=args.nms_mode == "batched")
     if args.prenms is not None:
         cfg = cfg.replace_in("train", rpn_pre_nms_top_n=args.prenms)
+    if args.pad_stem:
+        cfg = cfg.replace_in("network", stem_channel_pad=args.pad_stem)
     model = build_model(cfg)
     tr = cfg.train
     key = jax.random.PRNGKey(0)
@@ -408,6 +427,41 @@ def main(argv=None) -> None:
     record_stage("sum of pieces (approx)", acct)
     registry().set_gauge("profile/self_check_ratio",
                          round(acct / t_full, 4) if t_full > 0 else -1.0)
+
+    # --- quantized inference A/B (--quant; docs/PERF.md "Quantized
+    # inference"): the full TEST-MODE forward — the program serving and
+    # eval run — fp vs quantized, same chain methodology.  Calibration
+    # sweeps the synthetic batch (deterministic), so the arm is
+    # self-contained; accuracy is gated elsewhere (gauntlet/quant-smoke),
+    # this measures pure step time.
+    if args.quant:
+        from mx_rcnn_tpu.core.tester import calibrate_quant
+
+        test_images = jnp.asarray(np.asarray(batch.images, np.float32))
+        test_info = jnp.asarray(batch.im_info)
+
+        def fp_fwd_stage(c):
+            out = model.apply(variables, test_images + c * eps, test_info)
+            return carry_of(out[2])
+
+        timed_loop(fp_fwd_stage, "inference fwd (fp)",
+                   f"batch={n} post={model.test_post_nms_top_n}")
+
+        qcfg = cfg.replace_in("quant", enabled=True,
+                              dtype=args.quant_dtype, mode=args.quant_mode)
+        quant_col = calibrate_quant(
+            qcfg, variables["params"], variables["batch_stats"],
+            batches=[(np.asarray(test_images), np.asarray(batch.im_info))])
+        qmodel = build_model(qcfg)
+        qvars = {**variables, "quant": quant_col}
+
+        def q_fwd_stage(c):
+            out = qmodel.apply(qvars, test_images + c * eps, test_info)
+            return carry_of(out[2])
+
+        timed_loop(q_fwd_stage,
+                   f"inference fwd ({args.quant_dtype}/{args.quant_mode})",
+                   f"batch={n}")
 
     if args.check:
         _run_check(stage_ms, relowerings, acct, t_full)

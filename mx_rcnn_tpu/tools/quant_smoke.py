@@ -1,0 +1,230 @@
+"""``make quant-smoke``: prove the quantized inference path end to end.
+
+The gate-speed twin of the full quant gauntlet (docs/PERF.md "Quantized
+inference"): train the tiny network briefly on synthetic data, then
+assert the ISSUE-9 acceptance shape on this box:
+
+* **fp-off bit-identity** — with ``cfg.quant`` disabled (the default)
+  the Predictor's outputs are bit-equal to a direct jitted
+  ``model.apply`` (the pre-quant program path), and the quantized
+  model's param tree has exactly the fp model's names/shapes (fp32
+  checkpoints load into the quant model unchanged);
+* **accuracy gate PASSES on int8** — quantized eval (calibration sweep
+  → int8 native forward) stays within ``cfg.quant.map_delta_budget``
+  mAP of the fp eval of the same checkpoint;
+* **red-team arm FIRES the gate** — the over-quantized arm
+  (weight_bits=2) must lose MORE than the budget, proving the gate has
+  teeth (the full paired-seed version is ``tools/gauntlet.py --compare
+  e2e quant_redteam``);
+* **quantized AOT export round-trips** — ``export_serve_programs`` over
+  the quant predictor (bit-equality verified inside), then a FRESH
+  engine built from a fresh calibration warms from the store
+  (fingerprint admission) and serves a burst with ZERO post-join
+  recompiles and every request terminating SERVED;
+* **admission refuses mismatches** — an fp config and a
+  different-estimator quant config must both be refused by the store's
+  manifest check.
+
+``--check`` turns the assertions into the exit code (the ``make
+test-gate`` wiring).  ~2 min warm on this box.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import tempfile
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+# the quick-tier miniature recipe, shared with tools/obs_smoke.py
+# (tests/conftest.py — shrink_tiny_cfg pins the same knobs); only the
+# logging cadence differs — no per-step stdout wanted here
+from mx_rcnn_tpu.tools.obs_smoke import _TINY as _OBS_TINY
+
+_TINY = dict(_OBS_TINY, default__frequent=10_000)
+
+
+def _cfg(workdir: str, **kw):
+    from mx_rcnn_tpu.config import generate_config
+
+    over = dict(_TINY)
+    over.update({
+        "dataset__root_path": os.path.join(workdir, "data"),
+        "dataset__dataset_path": os.path.join(workdir, "data", "synthetic"),
+    })
+    over.update(kw)
+    return generate_config("tiny", "synthetic", **over)
+
+
+def run_smoke(workdir: str, num_images: int, epochs: int) -> dict:
+    """Train + the five assertions' evidence; returns the record dict."""
+    import jax
+    import numpy as np
+
+    from mx_rcnn_tpu.core.tester import Predictor, quant_predictor
+    from mx_rcnn_tpu.models import build_model
+    from mx_rcnn_tpu.obs.metrics import LoweringCounter
+    from mx_rcnn_tpu.serve.engine import ServingEngine
+    from mx_rcnn_tpu.serve.export import (ExportMismatch, ExportStore,
+                                          export_serve_programs)
+    from mx_rcnn_tpu.tools.loadgen import synthetic_images
+    from mx_rcnn_tpu.tools.test import test_rcnn
+    from mx_rcnn_tpu.tools.train import train_net
+
+    cfg = _cfg(workdir)
+    dataset_kw = {"num_images": num_images}
+    prefix = os.path.join(workdir, "model", "e2e")
+    state = train_net(cfg, prefix=prefix, end_epoch=epochs, seed=0,
+                      dataset_kw=dataset_kw)
+    params, batch_stats = state.params, state.batch_stats
+    ev: dict = {"epochs": epochs, "num_images": num_images}
+
+    # ---- fp-off bit-identity --------------------------------------------
+    model = build_model(cfg)  # quant disabled: the unchanged fp model
+    rng = np.random.RandomState(0)
+    images = (rng.rand(2, 128, 160, 3) * 255.0).astype(np.float32)
+    im_info = np.tile(np.array([128, 160, 1.0], np.float32), (2, 1))
+    pred = Predictor(model, {"params": params, "batch_stats": batch_stats},
+                     cfg)
+    via_pred = [np.asarray(o) for o in pred.raw(images, im_info)]
+    direct = [np.asarray(o) for o in jax.jit(model.apply)(
+        {"params": params, "batch_stats": batch_stats},
+        images, im_info)]
+    ev["fp_bit_identical"] = all(
+        a.dtype == b.dtype and (a == b).all()
+        for a, b in zip(via_pred, direct))
+    qcfg = cfg.replace_in("quant", enabled=True)
+    qmodel = build_model(qcfg)
+    q_init = qmodel.init(jax.random.PRNGKey(0),
+                         images[:1], im_info[:1])
+    fp_tree = jax.tree_util.tree_structure(params)
+    q_tree = jax.tree_util.tree_structure(q_init["params"])
+    ev["param_tree_unchanged"] = (fp_tree == q_tree) and all(
+        a.shape == b.shape for a, b in zip(
+            jax.tree_util.tree_leaves(params),
+            jax.tree_util.tree_leaves(q_init["params"])))
+
+    # ---- accuracy gate: fp vs int8 vs red-team --------------------------
+    res_fp = test_rcnn(cfg, prefix=prefix, epoch=epochs, verbose=False,
+                       dataset_kw=dataset_kw)
+    res_q = test_rcnn(qcfg, prefix=prefix, epoch=epochs, verbose=False,
+                      dataset_kw=dataset_kw)
+    rt_cfg = cfg.replace_in("quant", enabled=True, weight_bits=2)
+    res_rt = test_rcnn(rt_cfg, prefix=prefix, epoch=epochs, verbose=False,
+                       dataset_kw=dataset_kw)
+    budget = cfg.quant.map_delta_budget
+    ev.update({
+        "mAP_fp": round(float(res_fp["mAP"]), 4),
+        "mAP_int8": round(float(res_q["mAP"]), 4),
+        "mAP_redteam_2bit": round(float(res_rt["mAP"]), 4),
+        "budget": budget,
+        "quant_delta": round(float(res_q["mAP"] - res_fp["mAP"]), 4),
+        "redteam_delta": round(float(res_rt["mAP"] - res_fp["mAP"]), 4),
+    })
+    ev["accuracy_gate_pass"] = abs(ev["quant_delta"]) <= budget
+    ev["redteam_gate_fires"] = ev["redteam_delta"] < -budget
+
+    # ---- quantized AOT export round trip --------------------------------
+    qpred = quant_predictor(qcfg, params, batch_stats,
+                            dataset_kw=dataset_kw)
+    ev["calibration_fingerprint"] = qpred.quant_fingerprint
+    store_dir = os.path.join(workdir, "export")
+    report = export_serve_programs(qpred, qcfg, store_dir)
+    ev["export_bit_equal"] = bool(report["bit_equal"])
+    ev["export_programs"] = len(report["programs"])
+    # a FRESH engine from a FRESH calibration sweep: the admission check
+    # inside warm_from_export compares ITS fingerprint to the manifest's
+    qpred2 = quant_predictor(qcfg, params, batch_stats,
+                             dataset_kw=dataset_kw)
+    engine = ServingEngine(qpred2, qcfg)
+    join = engine.warm_from_export(ExportStore(store_dir))
+    ev["join"] = join
+    served = lost = 0
+    with LoweringCounter() as lc:
+        handles = [engine.submit(img, timeout_ms=0)
+                   for img in synthetic_images(qcfg, 8)]
+        for h in handles:
+            try:
+                h.wait(timeout=120)
+                served += 1
+            except Exception:
+                lost += 1
+    engine.close()
+    ev.update({"burst_served": served, "burst_lost": lost,
+               "post_join_lowerings": lc.n})
+
+    # ---- admission refusals ---------------------------------------------
+    store = ExportStore(store_dir)
+    try:
+        store.check(cfg)  # fp config against a quantized store
+        ev["refuses_fp_config"] = False
+    except ExportMismatch:
+        ev["refuses_fp_config"] = True
+    try:
+        est_cfg = qcfg.replace_in("quant", estimator="percentile")
+        ppred = quant_predictor(est_cfg, params, batch_stats,
+                                dataset_kw=dataset_kw)
+        store.check(est_cfg, quant_fingerprint=ppred.quant_fingerprint)
+        ev["refuses_estimator_mismatch"] = False
+    except ExportMismatch:
+        ev["refuses_estimator_mismatch"] = True
+    return ev
+
+
+def check(ev: dict) -> list:
+    """The acceptance assertions; returns a list of problem strings."""
+    problems = []
+    for flag in ("fp_bit_identical", "param_tree_unchanged",
+                 "accuracy_gate_pass", "redteam_gate_fires",
+                 "export_bit_equal", "refuses_fp_config",
+                 "refuses_estimator_mismatch"):
+        if not ev.get(flag):
+            problems.append(f"{flag} is false")
+    if ev.get("burst_lost"):
+        problems.append(f"{ev['burst_lost']} burst request(s) lost")
+    if ev.get("burst_served", 0) < 8:
+        problems.append(f"only {ev.get('burst_served')} of 8 served")
+    if ev.get("post_join_lowerings"):
+        problems.append(f"{ev['post_join_lowerings']} program(s) lowered "
+                        "AFTER the export-warm join (recompile leak)")
+    return problems
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--workdir", default=None,
+                   help="default: a fresh temp dir, removed on success")
+    p.add_argument("--num_images", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=6)
+    p.add_argument("--check", action="store_true",
+                   help="exit non-zero unless every assertion holds")
+    args = p.parse_args(argv)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="quant_smoke_")
+    ev = run_smoke(workdir, args.num_images, args.epochs)
+    problems = check(ev)
+    ev["problems"] = problems
+    print(json.dumps({"metric": "quant_smoke", "ok": not problems, **ev}))
+    if args.check and problems:
+        for pr in problems:
+            print(f"CHECK FAIL: {pr}")
+        return 1
+    if not args.workdir and not problems:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    if not problems:
+        print(f"CHECK OK: fp bit-identical, |quant delta| "
+              f"{abs(ev['quant_delta']):.4f} <= {ev['budget']}, red-team "
+              f"delta {ev['redteam_delta']:.4f} fired the gate, export "
+              f"round-trip bit-equal with {ev['post_join_lowerings']} "
+              f"post-join lowerings")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,12 +17,9 @@ import argparse
 import logging
 
 from mx_rcnn_tpu.config import generate_config
-from mx_rcnn_tpu.core.tester import Predictor
-from mx_rcnn_tpu.models import build_model
 from mx_rcnn_tpu.serve.engine import ServingEngine
 from mx_rcnn_tpu.serve.server import make_server
 from mx_rcnn_tpu.tools.train import add_set_arg, parse_set_overrides
-from mx_rcnn_tpu.utils.checkpoint import load_param
 
 logger = logging.getLogger("mx_rcnn_tpu")
 
@@ -76,10 +73,15 @@ def main(argv=None):
         from mx_rcnn_tpu.obs.metrics import ServeMetrics, registry
 
         metrics = ServeMetrics(registry=registry())
-    model = build_model(cfg)
-    params, batch_stats = load_param(args.prefix, args.epoch)
-    predictor = Predictor(
-        model, {"params": params, "batch_stats": batch_stats}, cfg)
+    # checkpoint → predictor, quantized when cfg.quant.enabled (the
+    # shared serving-CLI bootstrapping — docs/PERF.md "Quantized
+    # inference"; one --set quant__enabled=true away)
+    from mx_rcnn_tpu.tools.loadgen import init_predictor
+
+    predictor = init_predictor(cfg, args.prefix, args.epoch)
+    if cfg.quant.enabled:
+        logger.info("quant serving: %s/%s fingerprint=%s", cfg.quant.dtype,
+                    cfg.quant.mode, predictor.quant_fingerprint)
     engine = ServingEngine(predictor, cfg, metrics=metrics)
     if not args.no_warmup:
         logger.info("warming %d bucket(s) at batch %d ...",

@@ -50,11 +50,27 @@ def test_rcnn(cfg: Config, *, prefix: str, epoch: int,
     # caching would only add RSS (the cache pays off on multi-epoch reads)
     loader = TestLoader(roidb, cfg,
                         batch_images=cfg.test.batch_images * num_devices)
-    model = build_model(cfg)
     params, batch_stats = load_param(prefix, epoch)
-    predictor = Predictor(
-        model, {"params": params, "batch_stats": batch_stats}, cfg,
-        mesh=mesh)
+    if cfg.quant.enabled:
+        # quantized-inference eval (docs/PERF.md "Quantized inference"):
+        # calibrate activation scales on a held-out training sweep, then
+        # evaluate through the quantized forward — the mAP this returns
+        # against an fp run of the same checkpoint IS the accuracy gate
+        # (tools/gauntlet.py quant mode; make quant-smoke)
+        from mx_rcnn_tpu.core.tester import quant_predictor
+
+        logger.info("quant eval: %s/%s estimator=%s bits=%d",
+                    cfg.quant.dtype, cfg.quant.mode, cfg.quant.estimator,
+                    cfg.quant.weight_bits)
+        predictor = quant_predictor(cfg, params, batch_stats, mesh=mesh,
+                                    dataset_kw=dataset_kw)
+        logger.info("quant calibration fingerprint: %s",
+                    predictor.quant_fingerprint)
+    else:
+        model = build_model(cfg)
+        predictor = Predictor(
+            model, {"params": params, "batch_stats": batch_stats}, cfg,
+            mesh=mesh)
     results = pred_eval(predictor, loader, imdb, cfg, out_dir=out_dir,
                         verbose=verbose, save_dets=save_dets)
     for k, v in sorted(results.items()):

@@ -164,6 +164,14 @@ def train_net(cfg: Config, *, prefix: str, begin_epoch: int = 0,
     them to time steps and count per-epoch lowerings); a ``fault_plan``'s
     injector chains in front of a caller ``step_callback``.
     """
+    if cfg.quant.enabled:
+        # quantization is inference-only (docs/PERF.md "Quantized
+        # inference"): the quantized model needs the calibrated 'quant'
+        # collection a train step never carries.  Refuse up front
+        # instead of crashing deep inside flax.
+        raise ValueError(
+            "quant__enabled=true is inference-only — train with the fp "
+            "config and enable quant at test/serve/export time")
     if end_epoch is None:
         end_epoch = cfg.default.e2e_epoch
     if roidb is None:

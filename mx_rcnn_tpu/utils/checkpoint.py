@@ -22,6 +22,7 @@ Design differences from the reference:
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
 import json
 import os
@@ -86,9 +87,37 @@ def config_fingerprint(cfg) -> str:
     test) are deliberately excluded: changing a retention or serving knob
     does not change the training trajectory, and flagging it would
     desensitize the warning that exists to catch real recipe drift."""
-    parts = "\n".join(repr(getattr(cfg, s)) for s in _FINGERPRINT_SECTIONS
-                      if hasattr(cfg, s))
+    parts = "\n".join(_fingerprint_repr(getattr(cfg, s))
+                      for s in _FINGERPRINT_SECTIONS if hasattr(cfg, s))
     return hashlib.sha256(parts.encode()).hexdigest()[:16]
+
+
+# Layout levers added AFTER fingerprints were first recorded in
+# manifests/export stores: stripped from the fingerprint at their field
+# DEFAULT, so every pre-existing fingerprint stays admissible; a SET
+# lever changes the traced program and must (and does) land in it.
+_DEFAULT_STRIPPED_LEVERS = frozenset({"stem_channel_pad"})
+
+
+def _fingerprint_repr(section) -> str:
+    """Section repr as hashed into the fingerprint: the dataclass repr,
+    rebuilt field-by-field so ``_DEFAULT_STRIPPED_LEVERS`` members can be
+    dropped when they sit at their declared default (byte-identical to
+    ``repr(section)`` otherwise — field order/format match the
+    dataclass-generated ``__repr__``)."""
+    if not dataclasses.is_dataclass(section):
+        return repr(section)
+    parts = []
+    for f in dataclasses.fields(section):
+        if not f.repr:
+            continue
+        v = getattr(section, f.name)
+        if (f.name in _DEFAULT_STRIPPED_LEVERS
+                and f.default is not dataclasses.MISSING
+                and v == f.default):
+            continue
+        parts.append(f"{f.name}={v!r}")
+    return f"{type(section).__qualname__}({', '.join(parts)})"
 
 
 def make_topology(num_devices: int, num_processes: int = 1,

@@ -18,11 +18,15 @@
 #   PRETRAINED_EPOCH=<epoch suffix, default 0>
 #   REF_MAP=<reference mAP to compare against, default 79.3>
 #   TOLERANCE=<points, default 0.5>
+#   QUANT=0           skip the quantized-parity leg (step 5; default on)
+#   QUANT_TOLERANCE=<points the int8 eval may lose vs the fp eval, 0.5>
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
 REF_MAP="${REF_MAP:-79.3}"
 TOLERANCE="${TOLERANCE:-0.5}"
+QUANT="${QUANT:-1}"
+QUANT_TOLERANCE="${QUANT_TOLERANCE:-0.5}"
 PRETRAINED="${PRETRAINED:-data/pretrained/resnet-101}"
 PRETRAINED_EPOCH="${PRETRAINED_EPOCH:-0}"
 PREFIX="model/parity_resnet101_voc0712"
@@ -58,7 +62,7 @@ fi
 # (utils/pretrained.py raises unless EVERY backbone leaf is covered, both
 # directions — a cheap dry run before committing to training)
 # --------------------------------------------------------------------------
-echo "== step 1/4: pretrained import gate =="
+echo "== step 1/5: pretrained import gate =="
 python - "$PRETRAINED" "$PRETRAINED_EPOCH" <<'EOF' || exit 1
 import sys
 import jax
@@ -80,7 +84,7 @@ EOF
 # schedule; --quick shrinks epochs for a pipeline shakeout, NOT a verdict)
 # --------------------------------------------------------------------------
 if [ "${1:-}" = "--quick" ]; then EPOCHS=1; fi
-echo "== step 2/4: training resnet101 VOC07+12 e2e (${EPOCHS} epochs) =="
+echo "== step 2/5: training resnet101 VOC07+12 e2e (${EPOCHS} epochs) =="
 python -m mx_rcnn_tpu.tools.train \
   --network resnet101 --dataset PascalVOC \
   --image_set 2007_trainval+2012_trainval \
@@ -91,7 +95,7 @@ python -m mx_rcnn_tpu.tools.train \
 # --------------------------------------------------------------------------
 # Step 3: evaluate on VOC07 test
 # --------------------------------------------------------------------------
-echo "== step 3/4: evaluating on 2007_test =="
+echo "== step 3/5: evaluating on 2007_test =="
 MAP_LINE=$(python -m mx_rcnn_tpu.tools.test \
   --network resnet101 --dataset PascalVOC --image_set 2007_test \
   --prefix "$PREFIX" --epoch "$EPOCHS" | tee /dev/stderr | grep '^mAP = ')
@@ -100,8 +104,8 @@ MAP=$(echo "$MAP_LINE" | sed 's/mAP = //')
 # --------------------------------------------------------------------------
 # Step 4: the verdict
 # --------------------------------------------------------------------------
-echo "== step 4/4: parity verdict =="
-python - "$MAP" "$REF_MAP" "$TOLERANCE" <<'EOF'
+echo "== step 4/5: parity verdict =="
+python - "$MAP" "$REF_MAP" "$TOLERANCE" <<'EOF' || exit 1
 import sys
 map_pct, ref, tol = float(sys.argv[1]) * 100, float(sys.argv[2]), \
     float(sys.argv[3])
@@ -112,5 +116,36 @@ if delta >= -tol:
     print("parity verdict: PASS")
     sys.exit(0)
 print("parity verdict: FAIL")
+sys.exit(1)
+EOF
+
+# --------------------------------------------------------------------------
+# Step 5: quantized-parity leg (docs/PERF.md "Quantized inference") —
+# the SAME checkpoint evaluated through the int8 inference forward
+# (calibration sweep on the training split), gated at ±QUANT_TOLERANCE
+# of the fp mAP just measured.  This is the real-data twin of the
+# synthetic quant gauntlet (`make quant-smoke`; tools/gauntlet.py
+# --compare e2e quant) that runs the day data/weights appear.
+# --------------------------------------------------------------------------
+if [ "$QUANT" = "0" ]; then
+  echo "== step 5/5: quantized-parity leg SKIPPED (QUANT=0) =="
+  exit 0
+fi
+echo "== step 5/5: quantized-parity leg (int8 eval of the same ckpt) =="
+QMAP_LINE=$(python -m mx_rcnn_tpu.tools.test \
+  --network resnet101 --dataset PascalVOC --image_set 2007_test \
+  --prefix "$PREFIX" --epoch "$EPOCHS" \
+  --set quant__enabled=true | tee /dev/stderr | grep '^mAP = ')
+QMAP=$(echo "$QMAP_LINE" | sed 's/mAP = //')
+python - "$MAP" "$QMAP" "$QUANT_TOLERANCE" <<'EOF'
+import sys
+fp, q, tol = (float(v) for v in sys.argv[1:4])
+delta = (q - fp) * 100
+print(f"quantized mAP {q * 100:.2f} vs fp {fp * 100:.2f} "
+      f"(delta {delta:+.2f} pt, tolerance -{tol} pt)")
+if delta >= -tol:
+    print("quantized-parity verdict: PASS")
+    sys.exit(0)
+print("quantized-parity verdict: FAIL")
 sys.exit(1)
 EOF

@@ -1,0 +1,522 @@
+"""Quantized inference forward path (ops/quant.py + the model threading,
+Predictor quant mode, export-store admission — docs/PERF.md "Quantized
+inference").
+
+The contracts pinned here, in the ISSUE-9 acceptance order:
+
+* calibration determinism — the same calibration set produces
+  BIT-identical activation scales (absmax AND percentile estimators);
+* fake-quant (sim) vs real-int8 (native int32-accumulate) equivalence —
+  BIT-equal at tile sizes where fp32 accumulation of integer products is
+  exact, for both ``dot_general`` and conv;
+* fp-path bit-identity with quant off — ``conv()/dense()`` return the
+  UNCHANGED flax modules, the quant model's param tree equals the fp
+  model's (fp32 checkpoints load unchanged), and Predictor outputs are
+  bit-equal to a direct jitted apply;
+* export-store admission refusal on any quant-knob mismatch (fp↔quant,
+  dtype, estimator, calibration fingerprint);
+* the paired gauntlet gate FAILS on the red-team over-quantized arm
+  (record-level here; the real-training twin is the gate-marked test at
+  the bottom and ``make quant-smoke``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.ops.quant import (QuantSpec, calibration_fingerprint,
+                                   fake_quant, finalize_calibration, qconv,
+                                   qdot, quant_manifest_meta,
+                                   quant_program_tag, quantize_act,
+                                   quantize_weight, spec_from_config)
+
+from tests.conftest import shrink_tiny_cfg
+
+
+def _tiny_cfg(**quant_kw):
+    cfg = shrink_tiny_cfg(generate_config("tiny", "synthetic"))
+    if quant_kw:
+        cfg = cfg.replace_in("quant", **quant_kw)
+    return cfg
+
+
+def _tiny_state(cfg, batch=2):
+    from mx_rcnn_tpu.core.train import setup_training
+    from mx_rcnn_tpu.models import build_model
+
+    model = build_model(cfg)
+    state, _ = setup_training(model, cfg, jax.random.PRNGKey(0),
+                              (batch, 128, 160, 3), steps_per_epoch=10)
+    return model, state.params, state.batch_stats
+
+
+def _images(n=2, seed=0):
+    rng = np.random.RandomState(seed)
+    images = (rng.rand(n, 128, 160, 3) * 255.0).astype(np.float32)
+    im_info = np.tile(np.array([128, 160, 1.0], np.float32), (n, 1))
+    return images, im_info
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+def test_weight_quant_per_channel_symmetric(rng):
+    w = jnp.asarray(rng.randn(3, 3, 8, 16).astype(np.float32)) * \
+        jnp.arange(1, 17, dtype=jnp.float32)  # per-channel spread
+    spec = QuantSpec()
+    q, unit = quantize_weight(w, spec)
+    assert q.dtype == jnp.int8 and unit.shape == (16,)
+    # symmetric, zero-point 0: zero quantizes to exactly 0
+    qz, _ = quantize_weight(jnp.zeros_like(w), spec)
+    assert (np.asarray(qz) == 0).all()
+    # reconstruction within half a step everywhere (no clipping inside
+    # the absmax range by construction)
+    err = np.abs(np.asarray(q, np.float32) * np.asarray(unit)
+                 - np.asarray(w))
+    assert (err <= np.asarray(unit) / 2 + 1e-6).all()
+    # per-CHANNEL: each channel's scale tracks its own absmax
+    expect = np.abs(np.asarray(w)).max(axis=(0, 1, 2)) / 127.0
+    np.testing.assert_allclose(np.asarray(unit), expect, rtol=1e-6)
+
+
+def test_weight_bits_shrink_the_grid(rng):
+    w = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+    q8, _ = quantize_weight(w, QuantSpec(weight_bits=8))
+    q2, _ = quantize_weight(w, QuantSpec(weight_bits=2))
+    assert np.abs(np.asarray(q8)).max() > 1
+    assert set(np.unique(np.asarray(q2))) <= {-1, 0, 1}
+
+
+def test_fake_quant_round_trip_is_idempotent(rng):
+    x = jnp.asarray(rng.randn(64).astype(np.float32))
+    est = jnp.max(jnp.abs(x))
+    spec = QuantSpec(mode="sim")
+    once = fake_quant(x, est, spec)
+    twice = fake_quant(once, est, spec)
+    assert (np.asarray(once) == np.asarray(twice)).all()
+
+
+@pytest.mark.parametrize("dtype", ["int8", "fp8"])
+def test_sim_equals_native_dot_at_tile_level(rng, dtype):
+    """The sim/native pin: with K=64 every int32-accumulated sum is
+    exactly representable in fp32 (64·127² < 2²⁴), so the two paths are
+    BIT-equal; fp8 accumulates fp32 in both paths."""
+    x = jnp.asarray(rng.randn(5, 64).astype(np.float32)) * 3.0
+    w = jnp.asarray(rng.randn(64, 7).astype(np.float32))
+    est = jnp.max(jnp.abs(x))
+    sim = qdot(x, w, est, QuantSpec(dtype=dtype, mode="sim"))
+    native = qdot(x, w, est, QuantSpec(dtype=dtype, mode="native"))
+    assert sim.dtype == native.dtype == jnp.float32
+    assert (np.asarray(sim) == np.asarray(native)).all()
+
+
+def test_sim_equals_native_conv_at_tile_level(rng):
+    """Conv tile pin: 3·3·8 = 72 products per output < the fp32-exact
+    bound, so int32 and fp32 accumulation agree bit for bit."""
+    x = jnp.asarray(rng.randn(2, 10, 12, 8).astype(np.float32)) * 2.0
+    k = jnp.asarray(rng.randn(3, 3, 8, 16).astype(np.float32))
+    est = jnp.max(jnp.abs(x))
+    sim = qconv(x, k, est, QuantSpec(mode="sim"), (1, 1), "SAME")
+    native = qconv(x, k, est, QuantSpec(mode="native"), (1, 1), "SAME")
+    assert (np.asarray(sim) == np.asarray(native)).all()
+
+
+def test_quant_spec_validates_knobs():
+    with pytest.raises(ValueError, match="dtype"):
+        QuantSpec(dtype="int4")
+    with pytest.raises(ValueError, match="mode"):
+        QuantSpec(mode="fake")
+    with pytest.raises(ValueError, match="estimator"):
+        QuantSpec(estimator="minmax")
+    with pytest.raises(ValueError, match="weight_bits"):
+        QuantSpec(weight_bits=1)
+    with pytest.raises(ValueError, match="phase"):
+        QuantSpec(phase="train")
+    # fp8's qmax is the format's own max — narrowed weight_bits would be
+    # silently ignored (an fp8 red-team arm must refuse, not no-op)
+    with pytest.raises(ValueError, match="weight_bits"):
+        QuantSpec(dtype="fp8", weight_bits=2)
+    QuantSpec(dtype="fp8")  # full-width fp8 stays valid
+
+
+# ---------------------------------------------------------------------------
+# calibration
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("estimator", ["absmax", "percentile"])
+def test_calibration_deterministic(estimator):
+    """Same calibration set (same order) ⇒ BIT-identical scales and
+    fingerprint — twice in-process and against a freshly built model."""
+    from mx_rcnn_tpu.core.tester import calibrate_quant
+
+    cfg = _tiny_cfg(enabled=True, estimator=estimator)
+    _, params, bs = _tiny_state(cfg)
+    batches = [_images(seed=0), _images(seed=1)]
+    a = calibrate_quant(cfg, params, bs, batches=batches)
+    b = calibrate_quant(cfg, params, bs, batches=batches)
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb) and all(
+        (np.asarray(x) == np.asarray(y)).all() for x, y in zip(la, lb))
+    assert calibration_fingerprint(a, cfg.quant) == \
+        calibration_fingerprint(b, cfg.quant)
+
+
+def test_calibration_fingerprint_tracks_knobs_and_scales():
+    from mx_rcnn_tpu.core.tester import calibrate_quant
+
+    cfg = _tiny_cfg(enabled=True)
+    _, params, bs = _tiny_state(cfg)
+    col = calibrate_quant(cfg, params, bs, batches=[_images()])
+    fp = calibration_fingerprint(col, cfg.quant)
+    # estimator knob changes the fingerprint even at equal scales
+    other = cfg.replace_in("quant", estimator="percentile")
+    assert calibration_fingerprint(col, other.quant) != fp
+    # a different calibration set changes the scales -> the fingerprint
+    col2 = calibrate_quant(cfg, params, bs, batches=[_images(seed=7)])
+    assert calibration_fingerprint(col2, cfg.quant) != fp
+
+
+def test_estimators_differ_and_percentile_clips():
+    """percentile < absmax on heavy-tailed activations (that's the
+    point of the estimator), and both produce a scale per quant layer."""
+    from mx_rcnn_tpu.core.tester import calibrate_quant
+
+    base = _tiny_cfg(enabled=True)
+    _, params, bs = _tiny_state(base)
+    batches = [_images()]
+    col_a = calibrate_quant(base, params, bs, batches=batches)
+    col_p = calibrate_quant(
+        base.replace_in("quant", estimator="percentile", percentile=90.0),
+        params, bs, batches=batches)
+    la = jax.tree_util.tree_leaves(col_a)
+    lp = jax.tree_util.tree_leaves(col_p)
+    assert len(la) == len(lp) == 3  # conv1, conv2, head fc
+    assert all(float(p) <= float(a) + 1e-6 for a, p in zip(la, lp))
+    assert any(float(p) < float(a) for a, p in zip(la, lp))
+
+
+def test_finalize_calibration_shapes():
+    stats = {"layer": {"amax": jnp.asarray(4.0), "psum": jnp.asarray(6.0),
+                       "pcnt": jnp.asarray(2.0)}}
+    cfg = _tiny_cfg(enabled=True)
+    col = finalize_calibration(stats, cfg.quant)
+    assert float(col["layer"]["act_scale"]) == 4.0
+    colp = finalize_calibration(
+        stats, cfg.replace_in("quant", estimator="percentile").quant)
+    assert float(colp["layer"]["act_scale"]) == 3.0
+
+
+# ---------------------------------------------------------------------------
+# fp-path bit-identity with quant off + checkpoint compatibility
+# ---------------------------------------------------------------------------
+
+def test_fp_path_bit_identical_when_off():
+    """With quant disabled the construction path returns the UNCHANGED
+    flax modules and the Predictor's outputs equal a direct jitted
+    apply bit for bit — the 'every existing fp serving/eval output is
+    bit-identical to HEAD' pin."""
+    import flax.linen as nn
+
+    from mx_rcnn_tpu.core.tester import Predictor
+    from mx_rcnn_tpu.models.layers import conv, dense
+
+    assert type(conv(8)) is nn.Conv
+    assert type(dense(8)) is nn.Dense
+    cfg = _tiny_cfg()
+    assert not cfg.quant.enabled  # off by default
+    model, params, bs = _tiny_state(cfg)
+    images, im_info = _images()
+    pred = Predictor(model, {"params": params, "batch_stats": bs}, cfg)
+    assert pred.quant_fingerprint is None
+    via_pred = [np.asarray(o) for o in pred.raw(images, im_info)]
+    direct = [np.asarray(o) for o in jax.jit(model.apply)(
+        {"params": params, "batch_stats": bs}, images, im_info)]
+    for a, b in zip(via_pred, direct):
+        assert a.dtype == b.dtype and (a == b).all()
+
+
+def test_quant_model_param_tree_matches_fp():
+    """fp32 checkpoints load into the quantized model unchanged: same
+    param names, same shapes, same dtypes."""
+    from mx_rcnn_tpu.models import build_model
+
+    cfg = _tiny_cfg()
+    _, params, _ = _tiny_state(cfg)
+    qmodel = build_model(cfg.replace_in("quant", enabled=True))
+    images, im_info = _images(1)
+    q_init = qmodel.init(jax.random.PRNGKey(0), images, im_info)
+    assert jax.tree_util.tree_structure(params) == \
+        jax.tree_util.tree_structure(q_init["params"])
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(q_init["params"])):
+        assert a.shape == b.shape and a.dtype == b.dtype
+
+
+def test_quant_predictor_runs_and_redteam_collapses():
+    """int8 inference stays close to fp at the feature level; the 2-bit
+    red-team arm is catastrophically far — the fast twin of the gate
+    direction (`make quant-smoke` / the gate test below measure mAP)."""
+    from mx_rcnn_tpu.core.tester import calibrate_quant
+    from mx_rcnn_tpu.models import build_model
+
+    cfg = _tiny_cfg()
+    model, params, bs = _tiny_state(cfg)
+    images, im_info = _images()
+    feat_fp = np.asarray(model.apply(
+        {"params": params, "batch_stats": bs}, jnp.asarray(images),
+        jnp.asarray(im_info), method=model.features), np.float32)
+    scale = np.abs(feat_fp).max()
+
+    def feat_q(**kw):
+        qcfg = cfg.replace_in("quant", enabled=True, **kw)
+        col = calibrate_quant(qcfg, params, bs, batches=[(images, im_info)])
+        qm = build_model(qcfg)
+        return np.asarray(qm.apply(
+            {"params": params, "batch_stats": bs, "quant": col},
+            jnp.asarray(images), jnp.asarray(im_info),
+            method=qm.features), np.float32)
+
+    rel_int8 = np.abs(feat_q() - feat_fp).max() / scale
+    rel_2bit = np.abs(feat_q(weight_bits=2) - feat_fp).max() / scale
+    assert rel_int8 < 0.05, rel_int8
+    assert rel_2bit > 0.5, rel_2bit
+    assert rel_2bit > 10 * rel_int8
+
+
+def test_quant_program_keys_cannot_collide():
+    """A quantized Predictor tags every program key with the recipe +
+    calibration fingerprint, so fp and quant programs never share a
+    cache (or export) slot."""
+    from mx_rcnn_tpu.core.tester import Predictor, quant_predictor
+
+    cfg = _tiny_cfg()
+    model, params, bs = _tiny_state(cfg)
+    images, im_info = _images()
+    fp_pred = Predictor(model, {"params": params, "batch_stats": bs}, cfg)
+    qcfg = cfg.replace_in("quant", enabled=True)
+    qpred = quant_predictor(qcfg, params, bs, batches=[(images, im_info)])
+    k_fp = fp_pred.program_key("rpn", (images, im_info))
+    k_q = qpred.program_key("rpn", (images, im_info))
+    assert k_fp != k_q
+    assert k_q[0].startswith("quant[int8:native:absmax:b8:")
+    assert qpred.quant_fingerprint in k_q[0]
+    # and the tag helper agrees with the manifest block
+    tag = quant_program_tag(qcfg.quant, qpred.quant_fingerprint)
+    assert k_q[0] == tag + ":rpn"
+    meta = quant_manifest_meta(qcfg.quant, qpred.quant_fingerprint)
+    assert meta["calibration_fingerprint"] == qpred.quant_fingerprint
+
+
+def test_quant_predictor_refuses_uncalibrated_variables():
+    from mx_rcnn_tpu.core.tester import Predictor
+
+    cfg = _tiny_cfg(enabled=True)
+    from mx_rcnn_tpu.models import build_model
+
+    model = build_model(cfg)
+    with pytest.raises(ValueError, match="calibrate first"):
+        Predictor(model, {"params": {}, "batch_stats": {}}, cfg)
+
+
+def test_stem_channel_pad_bit_identity():
+    """The layout lever: conv0 padded 3→4 input channels with zero
+    inputs produces BIT-identical features when the first 3 kernel
+    channels are shared (zero channels contribute exact 0 to every
+    conv sum)."""
+    from mx_rcnn_tpu.models import build_model
+
+    cfg = _tiny_cfg()
+    model, params, bs = _tiny_state(cfg)
+    pmodel = build_model(cfg.replace_in("network", stem_channel_pad=4))
+    images, im_info = _images()
+    p_init = pmodel.init(jax.random.PRNGKey(0), images[:1], im_info[:1])
+    p_params = jax.device_get(p_init["params"])
+    k3 = np.asarray(params["backbone"]["conv1"]["kernel"])
+    k4 = np.array(p_params["backbone"]["conv1"]["kernel"])
+    assert k4.shape[2] == 4 and k3.shape[2] == 3
+    k4[:, :, :3, :] = k3  # share the real channels; ch 3 sees zeros
+    p_params["backbone"]["conv1"]["kernel"] = jnp.asarray(k4)
+    for name in ("conv2",):
+        p_params["backbone"][name] = params["backbone"][name]
+    feat = model.apply({"params": params, "batch_stats": bs},
+                       jnp.asarray(images), jnp.asarray(im_info),
+                       method=model.features)
+    feat_p = pmodel.apply({"params": p_params, "batch_stats": bs},
+                          jnp.asarray(images), jnp.asarray(im_info),
+                          method=pmodel.features)
+    assert (np.asarray(feat) == np.asarray(feat_p)).all()
+
+
+def test_stem_channel_pad_default_keeps_fingerprint():
+    """The layout lever must not invalidate pre-existing manifests /
+    export stores at its default: stem_channel_pad=0 stays OUT of the
+    config fingerprint, a set lever lands in it."""
+    from mx_rcnn_tpu.utils.checkpoint import (_fingerprint_repr,
+                                              config_fingerprint)
+
+    cfg = _tiny_cfg()
+    assert "stem_channel_pad" not in _fingerprint_repr(cfg.network)
+    padded = cfg.replace_in("network", stem_channel_pad=4)
+    assert "stem_channel_pad=4" in _fingerprint_repr(padded.network)
+    assert config_fingerprint(cfg) != config_fingerprint(padded)
+
+
+def test_train_refuses_quant_config():
+    """Quantization is inference-only: the training entry refuses a
+    quant-enabled config up front (the quantized model needs the
+    calibrated 'quant' collection a train step never carries)."""
+    from mx_rcnn_tpu.tools.train import train_net
+
+    cfg = _tiny_cfg().replace_in("quant", enabled=True)
+    with pytest.raises(ValueError, match="inference-only"):
+        train_net(cfg, prefix="/nonexistent/never-written")
+
+
+# ---------------------------------------------------------------------------
+# export-store admission
+# ---------------------------------------------------------------------------
+
+def _make_store(tmp_path, cfg, quant_meta):
+    from mx_rcnn_tpu.serve.export import ExportStore
+
+    store = ExportStore.create(str(tmp_path / "store"), cfg,
+                               extra_meta={"quant": quant_meta})
+    store.finish()
+    return ExportStore(str(tmp_path / "store"))
+
+
+def test_export_admission_refuses_quant_mismatch(tmp_path):
+    """The manifest quant block must equal the loading process's —
+    fp↔quant in either direction, dtype, estimator and calibration
+    fingerprint mismatches are all refusals."""
+    from mx_rcnn_tpu.serve.export import ExportMismatch
+
+    cfg = _tiny_cfg()
+    qcfg = cfg.replace_in("quant", enabled=True)
+    meta = quant_manifest_meta(qcfg.quant, "f" * 16)
+    qstore = _make_store(tmp_path, qcfg, meta)
+    # quant store + matching quant process: admitted
+    qstore.check(qcfg, quant_fingerprint="f" * 16)
+    # fp process against a quant store: refused
+    with pytest.raises(ExportMismatch, match="quant"):
+        qstore.check(cfg)
+    # fingerprint drift: refused
+    with pytest.raises(ExportMismatch, match="quant"):
+        qstore.check(qcfg, quant_fingerprint="0" * 16)
+    # estimator drift: refused
+    with pytest.raises(ExportMismatch, match="quant"):
+        qstore.check(qcfg.replace_in("quant", estimator="percentile"),
+                     quant_fingerprint="f" * 16)
+    # dtype drift: refused
+    with pytest.raises(ExportMismatch, match="quant"):
+        qstore.check(qcfg.replace_in("quant", dtype="fp8"),
+                     quant_fingerprint="f" * 16)
+    # fp store (records quant: None) + quant process: refused;
+    # + fp process: admitted (and old manifests without the key too)
+    fstore = _make_store(tmp_path / "fp", cfg, None)
+    with pytest.raises(ExportMismatch, match="quant"):
+        fstore.check(qcfg, quant_fingerprint="f" * 16)
+    fstore.check(cfg)
+
+
+@pytest.mark.slow
+def test_quant_export_round_trip_serves_bit_stable(tmp_path):
+    """Quantized AOT export: export_serve_programs over a quant
+    predictor verifies bit-equality inside; a second predictor from the
+    SAME calibration warms from the store (fingerprint admission) and
+    serves the exported programs."""
+    from mx_rcnn_tpu.serve.engine import ServingEngine
+    from mx_rcnn_tpu.serve.export import ExportStore, export_serve_programs
+    from mx_rcnn_tpu.core.tester import quant_predictor
+
+    cfg = _tiny_cfg(enabled=True)
+    cfg = cfg.replace_in("serve", batch_size=2, max_delay_ms=5.0)
+    _, params, bs = _tiny_state(cfg)
+    batches = [_images()]
+    qpred = quant_predictor(cfg, params, bs, batches=batches)
+    report = export_serve_programs(qpred, cfg, str(tmp_path / "store"))
+    assert report["bit_equal"] is True
+    assert json.load(open(report["manifest"]))["quant"][
+        "calibration_fingerprint"] == qpred.quant_fingerprint
+    qpred2 = quant_predictor(cfg, params, bs, batches=batches)
+    engine = ServingEngine(qpred2, cfg, start=True)
+    join = engine.warm_from_export(ExportStore(str(tmp_path / "store")))
+    assert join["programs"] >= 2
+    rng = np.random.RandomState(3)
+    img = rng.randint(0, 256, (100, 130, 3), np.uint8)
+    dets = engine.detect(img, timeout_ms=0)
+    assert isinstance(dets, dict)
+    engine.close()
+
+
+# ---------------------------------------------------------------------------
+# the accuracy gate (record-level; the real-training twin is gate-marked)
+# ---------------------------------------------------------------------------
+
+def test_paired_gate_fires_on_quant_redteam_records():
+    """Record-level pin of the FAIL direction: a quant_redteam arm that
+    collapses mAP must leave the paired CI far outside the budget."""
+    from mx_rcnn_tpu.tools.gauntlet import paired_compare
+
+    base = [0.7648, 0.7448, 0.7638, 0.7332, 0.7517]
+    recs = [{"mode": "e2e", "network": "tiny", "seed": s, "mAP": m}
+            for s, m in enumerate(base)]
+    recs += [{"mode": "quant_redteam", "network": "tiny", "seed": s,
+              "mAP": round(m * 0.1, 4)} for s, m in enumerate(base)]
+    cmp = paired_compare(recs, "e2e", "quant_redteam", "tiny", budget=0.05)
+    assert cmp["within_budget"] is False
+    assert cmp["mean_delta"] < -0.5
+    # and the neutral direction still passes: a faithful quant arm
+    recs2 = [r for r in recs if r["mode"] == "e2e"]
+    recs2 += [{"mode": "quant", "network": "tiny", "seed": s,
+               "mAP": round(m - 0.008, 4)} for s, m in enumerate(base)]
+    ok = paired_compare(recs2, "e2e", "quant", "tiny", budget=0.05)
+    assert ok["within_budget"] is True
+
+
+def test_gauntlet_quant_modes_registered():
+    from mx_rcnn_tpu.tools import gauntlet
+
+    assert "quant" in gauntlet._MODES
+    assert "quant_redteam" in gauntlet._MODES
+    assert gauntlet._QUANT_REDTEAM_BITS == 2
+
+
+@pytest.mark.slow
+@pytest.mark.gate
+def test_paired_gate_fires_on_quant_redteam_arm(tmp_path):
+    """Red-team of the quantization accuracy gate on a REAL training
+    pair (the quant analog of test_paired_gate_fires_on_damaged_arm):
+    e2e and quant_redteam share seeds (training bit-identical), the
+    2-bit eval arm collapses mAP, and `--compare e2e quant_redteam`
+    must exit 1 with decisively negative per-seed deltas."""
+    import io
+    from contextlib import redirect_stdout
+
+    from mx_rcnn_tpu.tools.gauntlet import main as gauntlet_main
+
+    out = tmp_path / "results.json"
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = gauntlet_main([
+            "--root", str(tmp_path), "--workdir", str(tmp_path / "w"),
+            "--out", str(out), "--network", "tiny",
+            "--seeds", "0", "1", "--epochs", "4", "--lr", "3e-3",
+            "--lr_step", "3", "--compare", "e2e", "quant_redteam"])
+    assert rc == 1, "quant gate FAIL direction did not fire"
+    cmp = [json.loads(line) for line in buf.getvalue().splitlines()
+           if '"compare"' in line][-1]
+    assert cmp["compare"] == "quant_redteam-vs-e2e"
+    assert all(d < -cmp["budget"] for d in cmp["deltas"]), cmp
+    assert cmp["within_budget"] is False
+    recs = json.loads(out.read_text())
+    assert all(r["damage"] == "quant__weight_bits=2" for r in recs
+               if r["mode"] == "quant_redteam")
