@@ -15,7 +15,7 @@ NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 
 .PHONY: all native lint test test-all test-gate serve-smoke ft-smoke \
 	obs-smoke perf-smoke elastic-smoke data-smoke fleet-smoke \
-	quant-smoke clean
+	quant-smoke threadlint-smoke clean
 
 all: native
 
@@ -24,11 +24,16 @@ native: $(NATIVE_LIB)
 $(NATIVE_LIB): $(NATIVE_SRC)
 	$(CXX) $(CXXFLAGS) -o $@ $(NATIVE_SRC)
 
-# TPU-graph hygiene static analysis (docs/ANALYSIS.md): fails on any
-# unwaived finding — the compile-time half of the recompile/leak guard
-# (tests/test_recompile_guard.py is the runtime half)
+# Static analysis battery (docs/ANALYSIS.md): fails on any unwaived
+# finding.  graphlint = jit/graph hygiene (runtime half:
+# tests/test_recompile_guard.py); threadlint = lock-order / shared-state
+# / signal-handler hygiene (runtime half: the lock sanitizer, armed by
+# threadlint-smoke); configlint = cfg.<section>.<key> reads vs the
+# config.py dataclasses + dead-key detection
 lint:
 	python -m mx_rcnn_tpu.analysis.graphlint mx_rcnn_tpu
+	python -m mx_rcnn_tpu.analysis.threadlint mx_rcnn_tpu
+	python -m mx_rcnn_tpu.analysis.configlint mx_rcnn_tpu
 
 # quick tier: unit + fast integration — measured ~6 min idle / 12 min
 # contended on this 1-core box (r5: 211 tests)
@@ -121,6 +126,20 @@ fleet-smoke:
 	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.loadgen \
 		--fleet_smoke --check
 
+# sanitized concurrency smoke (docs/ANALYSIS.md "threadlint"): re-runs
+# the serve and elastic smoke legs with the runtime lock sanitizer
+# armed in STRICT mode — every threading.Lock/RLock the serve/ft/data
+# planes allocate records its real acquisition order; an order
+# inversion raises at the acquiring site (failing the leg), a stall
+# > 30 s dumps all stacks, and each armed process prints a
+# LOCKSAN_REPORT line (children report through the storm harvest as
+# locksan_dirty_workers).  ~4 min warm on top of the unsanitized legs.
+threadlint-smoke:
+	env MXRCNN_THREAD_SANITIZER=strict \
+		python -m mx_rcnn_tpu.tools.loadgen --smoke --check
+	env MXRCNN_THREAD_SANITIZER=strict \
+		python -m mx_rcnn_tpu.tools.crashloop --elastic --smoke --check
+
 # elastic smoke (docs/FT.md "Elasticity"): a 2-process jax.distributed
 # CPU world loses one process to SIGTERM mid-epoch, shrinks onto the
 # survivor's device set (grad-accum rescaled so the global batch stays
@@ -135,15 +154,16 @@ elastic-smoke:
 # the two end-metric gates (30-epoch gauntlet seed-0 from scratch
 # ~22 min, 16-device hierarchical dryrun ~7 min on one core) — run
 # these for round-gate evidence; test-all stays green without them.
-# graphlint runs first: a hygiene violation fails the gate in seconds
+# the linters run first: a hygiene violation fails the gate in seconds
 # instead of after 30 minutes of training; serve-smoke next (~30 s),
 # then the perf-tooling smoke (~1 min), the observability smoke
 # (~1 min), the streaming input-plane smoke (data-smoke, ~30 s), the
 # serving-fleet smoke (fleet-smoke, ~2 min), the 2-kill crash loop
 # (ft-smoke, ~2 min), the quantized-inference smoke (quant-smoke,
-# ~2 min) and the elastic shrink/grow storm (elastic-smoke, ~3 min)
+# ~2 min), the elastic shrink/grow storm (elastic-smoke, ~3 min) and
+# the sanitizer-armed serve+elastic re-run (threadlint-smoke, ~4 min)
 test-gate: lint serve-smoke perf-smoke obs-smoke data-smoke fleet-smoke \
-		quant-smoke ft-smoke elastic-smoke
+		quant-smoke ft-smoke elastic-smoke threadlint-smoke
 	python -m pytest tests/ -x -q -m "gate"
 
 clean:

@@ -1,15 +1,28 @@
-"""Static analysis for TPU-graph hygiene.
+"""Static analysis + runtime sanitizers for the repo's machine-checked
+invariants (rule catalogues and waiver syntax: docs/ANALYSIS.md).
 
-The repo's core performance invariant (PAPER.md, docs/DESIGN.md) is that
-the hot path is ONE XLA program with static shapes — no host syncs, no
-per-step recompiles.  ``graphlint`` makes that invariant machine-checked:
-``python -m mx_rcnn_tpu.analysis.graphlint mx_rcnn_tpu`` (also ``make
-lint``) walks the graph-scope packages and reports violations by rule
-code.  The runtime counterpart lives in ``tests/test_recompile_guard.py``
-(jit cache-miss budget + tracer-leak checks).  Rule catalogue and waiver
-syntax: docs/ANALYSIS.md.
+Three linters share one Finding/waiver protocol (``common.py``), each
+paired with a runtime twin:
 
-Import ``RULES`` / ``lint_paths`` from ``mx_rcnn_tpu.analysis.graphlint``
-directly (kept out of this namespace so ``python -m`` does not double-load
-the module).
+* ``graphlint`` — TPU-graph hygiene: the hot path is ONE XLA program
+  with static shapes (PAPER.md, docs/DESIGN.md); flags host syncs,
+  dynamic-shape ops, jit-cache churn, dtype hazards in graph-scope
+  code.  Runtime twin: ``tests/test_recompile_guard.py`` (jit
+  cache-miss budget + tracer-leak checks).
+* ``threadlint`` — concurrency hygiene over the serve/ft/obs/data
+  planes: cross-module lock-order graph with cycle detection
+  (``--graph`` dumps it as JSON), unguarded thread-shared writes,
+  blocking calls under locks, signal-handler safety, Condition
+  predicates.  Runtime twin: ``sanitizer.py`` — opt-in instrumented
+  ``Lock``/``RLock`` recording real acquisition order, hold budgets
+  and stalls (``MXRCNN_THREAD_SANITIZER``; ``make threadlint-smoke``).
+* ``configlint`` — config-surface hygiene: every ``cfg.<section>.<key>``
+  read must exist in the ``config.py`` dataclasses (CL101), and
+  declared keys nobody reads are dead (CL201).
+
+All three run in ``make lint`` (first leg of ``make test-gate``):
+``python -m mx_rcnn_tpu.analysis.<tool> mx_rcnn_tpu``.
+
+Import ``RULES`` / ``lint_paths`` from the tool modules directly (kept
+out of this namespace so ``python -m`` does not double-load them).
 """

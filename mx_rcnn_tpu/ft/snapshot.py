@@ -233,6 +233,7 @@ class AsyncSnapshotter(_SnapshotterBase):
                             path, int(job.host_state.step))
             except BaseException as e:  # noqa: BLE001 — surfaced on train thread
                 logger.error("background snapshot write FAILED: %s", e)
+                # threadlint: disable=TL201 single writer thread, single reader (train); a reference store is atomic — worst case the error surfaces one snapshot later
                 self._error = e
             finally:
                 self._q.task_done()

@@ -75,6 +75,13 @@ class RestartPolicy:
         self.failures = 0          # consecutive no-progress failures
         self.identical = 0         # consecutive IDENTICAL failures
         self._last_sig: Optional[tuple] = None
+        # one policy is shared between the fleet health monitor and the
+        # per-replica relaunch threads (serve/fleet.py): an unguarded
+        # failures/identical update could lose a count and push a
+        # crash-looping replica past its give-up verdict (threadlint
+        # TL201; regression: test_restart_policy_record_is_thread_safe).
+        # RLock so delay_s stays callable from inside record.
+        self._lock = threading.RLock()
         if registry is None:
             from mx_rcnn_tpu.obs.metrics import registry as _registry
 
@@ -84,7 +91,8 @@ class RestartPolicy:
     def delay_s(self, n_failures: Optional[int] = None) -> float:
         """The backoff before restart attempt ``n_failures`` (1-based);
         0.0 while the run is making progress."""
-        n = self.failures if n_failures is None else n_failures
+        with self._lock:
+            n = self.failures if n_failures is None else n_failures
         if n <= 0:
             return 0.0
         d = min(self.base_s * self.factor ** (n - 1), self.cap_s)
@@ -102,26 +110,27 @@ class RestartPolicy:
         step works well); ``made_progress`` resets the whole schedule —
         a storm that advances between kills never backs off.
         """
-        if made_progress:
-            self.failures = 0
-            self.identical = 0
-            self._last_sig = None
-        else:
-            self.failures += 1
-            self.identical = (self.identical + 1
-                              if signature == self._last_sig else 1)
-            self._last_sig = signature
-        give_up = self.identical >= self.give_up_after
-        delay = self.delay_s()
+        with self._lock:
+            if made_progress:
+                self.failures = 0
+                self.identical = 0
+                self._last_sig = None
+            else:
+                self.failures += 1
+                self.identical = (self.identical + 1
+                                  if signature == self._last_sig else 1)
+                self._last_sig = signature
+            give_up = self.identical >= self.give_up_after
+            delay = self.delay_s()
+            failures, identical = self.failures, self.identical
         self._rec.set_gauge("ft.supervisor.backoff_s", delay)
-        self._rec.set_gauge("ft.supervisor.consecutive_failures",
-                            self.failures)
+        self._rec.set_gauge("ft.supervisor.consecutive_failures", failures)
         self._rec.set_gauge("ft.supervisor.crash_loop", int(give_up))
         if give_up:
             logger.error(
                 "crash-loop verdict: %d consecutive identical failures "
                 "(%r) — this is a deterministic bug, not a transient; "
-                "refusing to restart", self.identical, signature)
+                "refusing to restart", identical, signature)
         return delay, give_up
 
 # one kill event the scheduler will realize as a concrete fault plan once
@@ -474,20 +483,33 @@ class _Worker:
         self.proc = proc
         self.idx = idx
         self.gen = gen
-        self.lines: List[str] = []
-        self.events: List[Dict] = []
+        # the pump thread appends while the supervisor polls (wait_event
+        # spins on the event list mid-run) — both sides go through _lock
+        # so a poll can never observe a list mid-resize (threadlint TL201)
+        self._lock = threading.Lock()
+        self._lines: List[str] = []
+        self._events: List[Dict] = []
         self._thread = threading.Thread(target=self._pump, daemon=True)
         self._thread.start()
+
+    @property
+    def events(self) -> List[Dict]:
+        """Snapshot of the ELASTIC_EVENT records seen so far (the dicts
+        are shared — the supervisor's harvest tags them in place)."""
+        with self._lock:
+            return list(self._events)
 
     def _pump(self) -> None:
         for line in self.proc.stdout:
             line = line.rstrip("\n")
-            self.lines.append(line)
+            with self._lock:
+                self._lines.append(line)
             if line.startswith("ELASTIC_EVENT "):
                 try:
                     ev = json.loads(line[len("ELASTIC_EVENT "):])
                     ev["proc"] = self.idx
-                    self.events.append(ev)
+                    with self._lock:
+                        self._events.append(ev)
                 except ValueError:
                     pass  # torn line (process killed mid-write)
 
@@ -508,7 +530,15 @@ class _Worker:
         return self.proc.returncode
 
     def tail(self, n: int = 30) -> str:
-        return "\n".join(self.lines[-n:])
+        with self._lock:
+            return "\n".join(self._lines[-n:])
+
+    def locksan_dirty(self) -> bool:
+        """True when a sanitizer-armed child reported inversions or
+        watchdog trips at exit (analysis/sanitizer.py prints the
+        LOCKSAN_DIRTY marker; make threadlint-smoke fails on it)."""
+        with self._lock:
+            return any(l.startswith("LOCKSAN_DIRTY") for l in self._lines)
 
 
 def run_elastic_storm(workdir: str, *, smoke: bool = False,
@@ -553,6 +583,7 @@ def run_elastic_storm(workdir: str, *, smoke: bool = False,
     kills = {"TERM": 0, "KILL": 0}
     casualties = 0
     worlds = 0
+    locksan_dirty_workers = 0
     all_events: List[Dict] = []
     policy = RestartPolicy(seed=seed)
 
@@ -564,10 +595,14 @@ def run_elastic_storm(workdir: str, *, smoke: bool = False,
         return rec
 
     def harvest(workers: List[_Worker]) -> None:
+        nonlocal locksan_dirty_workers
         for w in workers:
-            for ev in w.events:
+            evs = w.events
+            for ev in evs:
                 ev.setdefault("by", f"worker{w.idx}.g{w.gen}")
-            all_events.extend(w.events)
+            all_events.extend(evs)
+            if w.locksan_dirty():
+                locksan_dirty_workers += 1
 
     def launch_world(gen: int, devices: int, procs: int,
                      local_devices: int) -> List[_Worker]:
@@ -830,6 +865,8 @@ def run_elastic_storm(workdir: str, *, smoke: bool = False,
         "restores_bit_identical": all(e.get("bit_identical")
                                       for e in restores),
         "unexpected_recompiles": unexpected,
+        # nonzero only when MXRCNN_THREAD_SANITIZER armed the children
+        "locksan_dirty_workers": locksan_dirty_workers,
         "recovery_ms": {
             "samples": [r["recovery_ms"] for r in recoveries],
             "by_kind": {r["kind"]: r["recovery_ms"] for r in recoveries},

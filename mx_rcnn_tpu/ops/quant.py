@@ -110,8 +110,10 @@ class QuantSpec:
         return float(2 ** (self.weight_bits - 1) - 1)
 
 
-def spec_from_config(qcfg, phase: str = "apply") -> QuantSpec:
-    """``cfg.quant`` → :class:`QuantSpec` (validates every knob)."""
+def spec_from_config(qcfg: "QuantConfig", phase: str = "apply") -> QuantSpec:
+    """``cfg.quant`` → :class:`QuantSpec` (validates every knob).  The
+    string annotation is documentation + configlint's section anchor —
+    ops/ stays import-light (no config.py import at runtime)."""
     return QuantSpec(dtype=qcfg.dtype, mode=qcfg.mode,
                      estimator=qcfg.estimator, percentile=qcfg.percentile,
                      weight_bits=qcfg.weight_bits, phase=phase)

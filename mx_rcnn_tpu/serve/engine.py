@@ -253,6 +253,7 @@ class ServingEngine:
             now = time.monotonic()
             tracing = obs_trace.enabled()
             for r in reqs:
+                # threadlint: disable=TL201 single writer (this bucket's only dispatcher); readers observe it after the _finish lock+Event publication barrier
                 r.dispatch_t = now
                 self.metrics.observe("queue_wait_ms",
                                      (now - r.enqueue_t) * 1e3)
@@ -288,6 +289,7 @@ class ServingEngine:
                         self.metrics.count("expired")
                     continue
                 dets = detections_from_keep(boxes_b, scores_b, keep_b, j)
+                # threadlint: disable=TL201 written before the terminal transition; readers (fleet callback, loadgen) observe it only after the _finish lock+Event publication barrier
                 r.batch_rows = len(reqs)
                 if r._finish(SERVED, result=dets):
                     self.metrics.count("served")
@@ -408,6 +410,7 @@ class ServingEngine:
         the dispatchers exit.  A batch already mid-model completes —
         same as a real preemption, where in-flight device work either
         finishes or the whole process is gone."""
+        # threadlint: disable=TL201 monotonic bool flip (never un-set); admission authority stays with BoundedQueue.close under its condition lock
         self._closed = True
         err = RuntimeError("replica killed")
         for q in self.queues.values():

@@ -213,6 +213,11 @@ class ReplicaManager:
         self.registry = registry or process_registry()
         self.ejects = 0
         self.relaunches = 0
+        # eject (health-monitor thread) and relaunch (per-replica rebuild
+        # threads) bump these concurrently; += on a plain int loses
+        # updates under interleaving (threadlint TL201; regression:
+        # test_fleet.py — test_manager_counters_are_thread_safe)
+        self._counts_lock = threading.Lock()
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
 
@@ -291,7 +296,8 @@ class ReplicaManager:
                 return
             r.state = R_EJECTED
             eng = r.engine
-        self.ejects += 1
+        with self._counts_lock:
+            self.ejects += 1
         served = 0
         if eng is not None:
             eng.kill()
@@ -315,7 +321,8 @@ class ReplicaManager:
             r.relaunch_at = time.monotonic() + delay
 
     def _relaunch(self, r: Replica) -> None:
-        self.relaunches += 1
+        with self._counts_lock:
+            self.relaunches += 1
         if r.launch():
             r.policy.record(("rejoined",), made_progress=True)
             logger.info("replica %d rejoined the fleet", r.id)

@@ -71,6 +71,11 @@ def _check_elastic(rec: dict, smoke: bool) -> list:
     if not rec["completed"]:
         problems.append(f"run did not complete ({rec['final_step']} < "
                         f"{rec['total_steps']} steps)")
+    if rec.get("locksan_dirty_workers"):
+        problems.append(
+            f"{rec['locksan_dirty_workers']} sanitizer-armed worker(s) "
+            "reported lock-order inversions or watchdog trips "
+            "(LOCKSAN_DIRTY)")
     return problems
 
 
@@ -102,6 +107,13 @@ def main(argv=None) -> None:
                    help="run the multi-process elastic preemption storm "
                         "instead of the single-process crash loop")
     args = p.parse_args(argv)
+    # opt-in lock sanitizer (make threadlint-smoke): arms THIS process;
+    # training children inherit the env var and arm themselves in
+    # tools/train.py, reporting LOCKSAN_REPORT/LOCKSAN_DIRTY lines the
+    # storm harvest folds into the record
+    from mx_rcnn_tpu.analysis import sanitizer
+
+    sanitizer.maybe_install_from_env()
 
     if args.elastic:
         auto_workdir = args.workdir is None
@@ -117,7 +129,8 @@ def main(argv=None) -> None:
                 json.dump(rec, f, indent=1)
             logger.info("record written to %s", args.out)
         if args.check:
-            problems = _check_elastic(rec, args.smoke)
+            problems = _check_elastic(rec, args.smoke) \
+                + sanitizer.check_problems()
             for msg in problems:
                 logger.error("CHECK FAILED: %s", msg)
             if problems:
@@ -158,7 +171,7 @@ def main(argv=None) -> None:
         logger.info("record written to %s", args.out)
 
     if args.check:
-        problems = []
+        problems = sanitizer.check_problems()
         if not rec["bit_identical"]:
             problems.append("survivor final TrainState is NOT bit-identical "
                             "to the control run")

@@ -272,6 +272,10 @@ def cmd_join_bench(args) -> int:
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
+    # opt-in lock sanitizer (MXRCNN_THREAD_SANITIZER; docs/ANALYSIS.md)
+    from mx_rcnn_tpu.analysis import sanitizer
+
+    sanitizer.maybe_install_from_env()
     args = parse_args(argv)
     return {"export": cmd_export, "serve": cmd_serve,
             "join_bench": cmd_join_bench}[args.cmd](args)

@@ -66,6 +66,7 @@ from typing import List
 
 import numpy as np
 
+from mx_rcnn_tpu.analysis import sanitizer
 from mx_rcnn_tpu.config import Config, generate_config
 from mx_rcnn_tpu.core.tester import Predictor
 from mx_rcnn_tpu.models import build_model
@@ -627,6 +628,7 @@ def run_fleet_bench(args) -> int:
         with open(args.out, "w") as f:
             json.dump(rec, f, indent=1)
     if args.check:
+        problems += sanitizer.check_problems()
         for msg in problems:
             logger.error("CHECK FAILED: %s", msg)
         return 1 if problems else 0
@@ -648,6 +650,9 @@ def _smoke_overrides() -> dict:
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
+    # opt-in lock sanitizer (make threadlint-smoke runs the serve legs
+    # with MXRCNN_THREAD_SANITIZER=strict; docs/ANALYSIS.md "threadlint")
+    sanitizer.maybe_install_from_env()
     p = argparse.ArgumentParser(
         description="Serving load generator + BENCH JSON "
                     "(docs/SERVING.md)")
@@ -830,7 +835,7 @@ def main(argv=None) -> int:
         with open(args.out, "w") as f:
             json.dump(rec, f, indent=1)
     if args.check:
-        problems = []
+        problems = sanitizer.check_problems()
         if lost != 0:
             problems.append(f"{lost} requests lost (no terminal state)")
         if lc.n != 0:

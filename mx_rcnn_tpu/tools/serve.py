@@ -50,6 +50,11 @@ def parse_args(argv=None) -> argparse.Namespace:
 def main(argv=None):
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
+    # opt-in lock sanitizer (MXRCNN_THREAD_SANITIZER; docs/ANALYSIS.md
+    # "threadlint") — a live server can run with real-order recording on
+    from mx_rcnn_tpu.analysis import sanitizer
+
+    sanitizer.maybe_install_from_env()
     args = parse_args(argv)
     cfg = generate_config(args.network, args.dataset,
                           **parse_set_overrides(args))

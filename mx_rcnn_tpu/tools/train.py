@@ -545,6 +545,13 @@ def parse_args(argv=None) -> argparse.Namespace:
 def main(argv=None):
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
+    # opt-in lock sanitizer, FIRST — the crashloop/elastic smokes arm it
+    # via MXRCNN_THREAD_SANITIZER in the child env, and every lock the
+    # snapshotter/loader/elastic controller builds must be born wrapped
+    # (docs/ANALYSIS.md "threadlint")
+    from mx_rcnn_tpu.analysis import sanitizer
+
+    sanitizer.maybe_install_from_env()
     args = parse_args(argv)
     multiproc = args.coordinator is not None
     if multiproc:

@@ -1,0 +1,92 @@
+"""threadlint fixture: every TL rule must fire in this file (pinned by
+tests/test_threadlint.py — the linter cannot silently lose a rule).
+
+Each block is the minimal BAD version of a pattern the real tree either
+avoids or guards; none of this code is ever imported or run.
+"""
+
+import queue
+import signal
+import threading
+import time
+
+
+class Inverted:
+    """ab() takes _a then _b; ba() takes _b then _a — the classic
+    lock-order inversion: two threads running one each deadlock."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:          # TL101: edge _a -> _b
+                pass
+
+    def ba(self):
+        with self._b:
+            with self._a:          # TL101: edge _b -> _a closes the cycle
+                pass
+
+    def reenter(self):
+        with self._a:
+            with self._a:          # TL102: non-reentrant Lock re-acquired
+                pass
+
+
+class Shared:
+    """A worker thread mutates state the main thread reads — without the
+    lock the class itself owns."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self.items = {}
+        self._q = queue.Queue()
+        self._t = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        self.total += 1            # TL201: unguarded shared write
+        if "k" not in self.items:  # TL202: check-then-act outside the lock
+            self.items["k"] = 1
+
+    def blocking(self):
+        with self._lock:
+            time.sleep(1.0)        # TL301: sleep while holding the lock
+            self._q.get()          # TL301: unbounded Queue.get under lock
+
+    def read(self):
+        with self._lock:
+            return self.total, dict(self.items)
+
+
+class Waiter:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.ready = False
+
+    def bad_wait(self):
+        with self._cond:
+            if not self.ready:
+                self._cond.wait()  # TL501: wait under 'if', not 'while'
+
+
+def _handler(signum, frame):
+    import jax
+
+    jax.block_until_ready(None)    # TL401: jax work inside a handler
+
+
+def arm():
+    signal.signal(signal.SIGUSR1, _handler)
+
+
+def waivers():
+    s = Shared()
+    with s._lock:
+        time.sleep(0.1)  # threadlint: disable=TL301
+    # ^ reasonless waiver: silences its TL301 but raises TL001
+    with s._lock:
+        time.sleep(0.1)  # threadlint: disable=TL999 no such rule
+    # ^ waiver naming an unknown rule: TL002 (its TL301 stays active)

@@ -10,6 +10,7 @@ handful of quick-tier programs once.
 """
 
 import os
+import sys
 import threading
 import time
 
@@ -361,6 +362,30 @@ def test_crash_eject_reroute_relaunch_rejoin(predictor):
     finally:
         gate.open()
         router.close()
+
+
+def test_manager_counters_are_thread_safe():
+    """Regression for the ISSUE-10 threadlint TL201 fix: ejects (health
+    monitor thread) and relaunches (per-replica rebuild threads) are
+    bumped concurrently; unguarded += on a plain int loses updates under
+    interleaving.  48 concurrent ejects must count exactly 48."""
+    cfg = _fleet_cfg(replicas=48, fleet__relaunch=False)
+    manager = ReplicaManager(lambda rid: (None, {}), cfg)
+    for r in manager.replicas:
+        r.state = R_READY          # stub: never launched, engine None
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)    # force frequent interleaving
+    try:
+        threads = [threading.Thread(target=manager.eject, args=(r, "test"))
+                   for r in manager.replicas]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        sys.setswitchinterval(old)
+    assert manager.ejects == len(manager.replicas)
+    assert all(r.state == R_DEAD for r in manager.replicas)
 
 
 def test_crash_loop_becomes_verdict_not_infinite_relaunch(predictor):

@@ -12,6 +12,8 @@ of the last one — actual SIGKILLs, torn files, subprocess restarts — is
 """
 
 import os
+import sys
+import threading
 
 import jax
 import numpy as np
@@ -448,6 +450,39 @@ def test_restart_policy_backoff_schedule():
     p2.record(("KILL", 5), made_progress=False)
     delay, give_up = p2.record(("KILL", 9), made_progress=True)
     assert delay == 0.0 and not give_up and p2.failures == 0
+
+
+def test_restart_policy_record_is_thread_safe():
+    """Regression for the ISSUE-10 threadlint TL201 fix: one policy is
+    shared between the fleet health monitor and the per-replica relaunch
+    threads (serve/fleet.py), and record()'s unguarded counter updates
+    lost counts under interleaving — which skews both the backoff
+    schedule and the give-up verdict.  With the policy lock, N threads x
+    M no-progress records land exactly N*M failures."""
+    from mx_rcnn_tpu.ft.supervisor import RestartPolicy
+    from mx_rcnn_tpu.obs.metrics import Registry
+
+    # factor=1 keeps delay_s finite at thousands of failures (2.0**n
+    # overflows float); the huge give_up_after keeps the verdict away
+    p = RestartPolicy(base_s=0.0, factor=1.0, give_up_after=10**9, seed=0,
+                      registry=Registry())
+    n_threads, per = 8, 400
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)  # force frequent interleaving
+    try:
+        def hammer(t):
+            for i in range(per):
+                p.record((t, i), made_progress=False)
+
+        threads = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        sys.setswitchinterval(old)
+    assert p.failures == n_threads * per
 
 
 # ---- manifest topology + resume admission (elastic era) --------------------
