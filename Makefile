@@ -15,7 +15,7 @@ NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 
 .PHONY: all native lint test test-all test-gate serve-smoke ft-smoke \
 	obs-smoke perf-smoke elastic-smoke data-smoke fleet-smoke \
-	quant-smoke threadlint-smoke clean
+	quant-smoke threadlint-smoke bulk-smoke clean
 
 all: native
 
@@ -126,6 +126,18 @@ fleet-smoke:
 	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.loadgen \
 		--fleet_smoke --check
 
+# bulk-inference smoke (docs/SERVING.md "Bulk tier"): the gate-scale
+# kill+resume protocol — a 48-image corpus scored through a 2-replica
+# export-warmed fleet three ways (uninterrupted control, SIGKILL after
+# the mid-corpus shard commit, resume of the killed sink) — fails
+# unless every run accounts N in = N accounted with 0 lost and 0
+# post-warm recompiles, the kill lands mid-corpus, the resume starts at
+# the killed run's cursor, and the killed+resumed shard set is
+# BYTE-identical to the control's.  ~2 min warm.
+bulk-smoke:
+	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.bulk \
+		--smoke --check
+
 # sanitized concurrency smoke (docs/ANALYSIS.md "threadlint"): re-runs
 # the serve and elastic smoke legs with the runtime lock sanitizer
 # armed in STRICT mode — every threading.Lock/RLock the serve/ft/data
@@ -158,12 +170,13 @@ elastic-smoke:
 # instead of after 30 minutes of training; serve-smoke next (~30 s),
 # then the perf-tooling smoke (~1 min), the observability smoke
 # (~1 min), the streaming input-plane smoke (data-smoke, ~30 s), the
-# serving-fleet smoke (fleet-smoke, ~2 min), the 2-kill crash loop
-# (ft-smoke, ~2 min), the quantized-inference smoke (quant-smoke,
-# ~2 min), the elastic shrink/grow storm (elastic-smoke, ~3 min) and
-# the sanitizer-armed serve+elastic re-run (threadlint-smoke, ~4 min)
+# serving-fleet smoke (fleet-smoke, ~2 min), the bulk kill+resume
+# smoke (bulk-smoke, ~2 min), the 2-kill crash loop (ft-smoke,
+# ~2 min), the quantized-inference smoke (quant-smoke, ~2 min), the
+# elastic shrink/grow storm (elastic-smoke, ~3 min) and the
+# sanitizer-armed serve+elastic re-run (threadlint-smoke, ~4 min)
 test-gate: lint serve-smoke perf-smoke obs-smoke data-smoke fleet-smoke \
-		quant-smoke ft-smoke elastic-smoke threadlint-smoke
+		bulk-smoke quant-smoke ft-smoke elastic-smoke threadlint-smoke
 	python -m pytest tests/ -x -q -m "gate"
 
 clean:

@@ -281,11 +281,14 @@ class DataConfig:
     # StreamLoader``) for training: image-granular epoch plan that is a
     # pure function of (seed, epoch), so shard unions and mid-epoch
     # resumes stay exactly-once across ANY worker/process/accum
-    # topology.  False keeps the classic AnchorLoader plan (bit-pinned
-    # by the pre-r7 resume tests); multi-process worlds shard the
-    # classic plan by batch ROWS either way, so N processes decode 1/N
-    # of the data in both modes.
-    streaming: bool = False
+    # topology.  DEFAULT FLIPPED to true at PR 11 after the soak leg
+    # (train data-smoke + elastic-smoke green with streaming on —
+    # docs/DATA.md "Streaming by default"); ``--set
+    # data__streaming=false`` is the escape hatch back to the classic
+    # AnchorLoader plan (bit-pinned by the pre-r7 resume tests).
+    # Multi-process worlds shard the plan by batch ROWS either way, so
+    # N processes decode 1/N of the data in both modes.
+    streaming: bool = True
     # double-buffered host→device staging (``data/staging.py``): a
     # background thread assembles + device_puts the NEXT batch(es)
     # while the in-flight step runs, so the fit loop's data_wait gauge
@@ -375,6 +378,38 @@ class FleetConfig:
     # relaunch crashed replicas (RestartPolicy paces retries and turns
     # repeated identical failures into a crash-loop verdict)
     relaunch: bool = True
+
+
+@dataclass(frozen=True)
+class BulkConfig:
+    """TPU addition (no reference equivalent — the reference scores a
+    corpus through a synchronous single-GPU eval loop): policy knobs for
+    the offline bulk-inference plane (``serve/bulk.py``,
+    docs/SERVING.md "Bulk tier") — a StreamLoader-fed corpus driven
+    through the serving fleet's bucket lanes with backpressure-bounded
+    in-flight depth and exactly-once sink accounting.
+
+    Same 3-level precedence as every section (hardcoded defaults <
+    presets < ``--set bulk__field=value`` CLI overrides).
+    """
+
+    # in-flight images admitted to the fleet at once (the backpressure
+    # bound: the feeder blocks once this many images are between
+    # submit_prepared and their terminal state).  0 = auto:
+    # 2 x serve.batch_size x fleet.replicas, clamped under the per-lane
+    # shed watermark so steady-state bulk traffic never sheds.
+    max_inflight: int = 0
+    # plan batches per committed sink shard — the atomicity AND resume
+    # unit: a shard lands via tmp → fsync → rename (all-or-nothing under
+    # SIGKILL) and the resume cursor is the contiguous committed-shard
+    # prefix, so a killed run restarts exactly-once at the first
+    # uncommitted shard.
+    shard_batches: int = 16
+    # resubmit budget per image for replica-death / shed transients (the
+    # fleet router's own reroute_retries sit BELOW this — a resubmit is
+    # a fresh fleet request).  Exhausting it aborts the whole run loudly:
+    # bulk never silently drops an image (N in = N accounted).
+    retries: int = 8
 
 
 @dataclass(frozen=True)
@@ -566,6 +601,7 @@ class Config:
     data: DataConfig = field(default_factory=DataConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    bulk: BulkConfig = field(default_factory=BulkConfig)
     ft: FTConfig = field(default_factory=FTConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     elastic: ElasticConfig = field(default_factory=ElasticConfig)

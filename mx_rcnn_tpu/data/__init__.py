@@ -17,8 +17,8 @@ TPU-native differences:
 from mx_rcnn_tpu.data.cache import DecodedImageCache  # noqa: F401
 from mx_rcnn_tpu.data.image import load_and_transform, resize_to_bucket  # noqa: F401
 from mx_rcnn_tpu.data.loader import (AnchorLoader, ROITestLoader,  # noqa: F401
-                                     StreamLoader, TestLoader,
-                                     cache_from_config,
+                                     StreamLoader, StreamTestLoader,
+                                     TestLoader, cache_from_config,
                                      decode_pool_from_config,
                                      stream_cache_budget)
 from mx_rcnn_tpu.data.roidb import IMDB, filter_roidb, merge_roidbs  # noqa: F401

@@ -590,11 +590,15 @@ class StreamLoader(AnchorLoader):
         return seq
 
     def _plan(self, epoch: int, batch_images: int,
-              offsets: Optional[Dict] = None) -> List:
+              offsets: Optional[Dict] = None,
+              orders: Optional[Dict] = None) -> List:
         """The epoch's global batch plan [(bucket, indices), ...] for a
         given batch size, optionally starting each bucket's stream at a
-        consumed-prefix ``offsets[bucket]``."""
-        orders = self._bucket_orders(epoch)
+        consumed-prefix ``offsets[bucket]``.  ``orders`` lets a caller
+        that already built the per-bucket epoch orders (an O(corpus)
+        shuffle) reuse them."""
+        if orders is None:
+            orders = self._bucket_orders(epoch)
         off = dict(offsets or {})
         streams = {b: o[off.get(b, 0):] for b, o in orders.items()}
         counts = {b: len(s) // batch_images for b, s in streams.items()}
@@ -683,6 +687,84 @@ class StreamLoader(AnchorLoader):
             self._shard_rows(plan),
             lambda b: self._make_batch(b[1], b[0]),
             self.num_workers, self.prefetch, rec=self._rec)
+
+
+class StreamTestLoader(StreamLoader):
+    """Eval-mode streaming loader: the topology-invariant
+    :class:`StreamLoader` plan pointed at INFERENCE (docs/SERVING.md
+    "Bulk tier").  Yields ``(Batch, indices, scales)`` exactly like
+    :class:`TestLoader` (gt fields zero-filled, ``indices`` are roidb
+    positions, ``scales`` un-map detections to raw image coordinates),
+    but the batch sequence is the deterministic StreamLoader plan
+    EXTENDED to cover every image: after the interleaved full batches,
+    each bucket's remainder is appended as one final PARTIAL batch
+    (sorted bucket order), so a corpus pass decodes each image exactly
+    once — the scoring plane's "N in = N accounted" invariant needs the
+    tail that :class:`StreamLoader` (whose contract is training batches
+    of static shape) drops.
+
+    The plan is a pure function of ``(seed, epoch=0)``, so a resumed run
+    recomputes it and repositions with :meth:`skip_next_batches` — the
+    bulk sink's cursor is a committed-batch count, and batch ``k`` of a
+    resumed run is IDENTICAL (bucket, image indices, row order) to batch
+    ``k`` of the uninterrupted run (the byte-identical-union invariant
+    of ``serve/bulk.py`` rests on this).  Default ``shuffle=False``:
+    corpus scoring wants the stable roidb order (still bucket-grouped by
+    the plan); ``shuffle=True`` keeps the per-bucket RNG order available
+    for sampling runs.
+    """
+
+    def __init__(self, roidb, cfg: Config, batch_images: int = None,
+                 shuffle: bool = False, seed: int = 0, **kw):
+        super().__init__(roidb, cfg,
+                         batch_images or cfg.test.batch_images,
+                         shuffle, seed, **kw)
+
+    def __len__(self) -> int:
+        import math
+
+        return sum(
+            math.ceil(len(self._indices_for(bucket)) / self.batch_images)
+            for bucket in set(self._bucket_ids)
+        )
+
+    def _plan(self, epoch: int, batch_images: int,
+              offsets: Optional[Dict] = None,
+              orders: Optional[Dict] = None) -> List:
+        if orders is None:  # built once: the parent reuses it below
+            orders = self._bucket_orders(epoch)
+        plan = super()._plan(epoch, batch_images, offsets, orders=orders)
+        # append each bucket's dropped remainder as one partial batch
+        consumed: Dict = {}
+        for bucket, idx in plan:
+            consumed[bucket] = consumed.get(bucket, 0) + len(idx)
+        off = dict(offsets or {})
+        for bucket in sorted(orders):
+            stream = orders[bucket][off.get(bucket, 0):]
+            tail = stream[consumed.get(bucket, 0):]
+            if tail:
+                plan.append((bucket, tail))
+        return plan
+
+    def _make_batch(self, indices: Sequence[int], bucket):
+        cfg = self.cfg
+        n = len(indices)
+        images = self._image_buffer(n, bucket)
+        im_info = np.zeros((n, 3), np.float32)
+        scales = np.zeros((n,), np.float32)
+        recs = [self.roidb[i] for i in indices]
+        infos = self._images_into(images, recs, bucket)
+        for j, (h, w, im_scale) in enumerate(infos):
+            im_info[j] = (h, w, im_scale)
+            scales[j] = im_scale
+        g = cfg.train.max_gt_boxes
+        batch = Batch(
+            images, im_info,
+            np.zeros((n, g, 4), np.float32),
+            np.zeros((n, g), np.int32),
+            np.zeros((n, g), bool),
+        )
+        return batch, list(indices), scales
 
 
 class ROIIter(AnchorLoader):

@@ -12,14 +12,22 @@ Layers, bottom-up:
   cold replica joins in seconds instead of paying trace+compile;
 * ``fleet.py``   — the fleet tier: N replica engines over device subsets
   behind a join-shortest-queue router with eject/relaunch
-  (docs/SERVING.md "Fleet tier").
+  (docs/SERVING.md "Fleet tier");
+* ``bulk.py``    — the bulk tier: StreamLoader-fed offline corpus
+  scoring through the fleet's bucket lanes with exactly-once sharded
+  sink accounting and a committed-prefix resume cursor
+  (docs/SERVING.md "Bulk tier").
 
 Entry points: ``python -m mx_rcnn_tpu.tools.serve`` (checkpoint → warmed
 HTTP service), ``python -m mx_rcnn_tpu.tools.fleet`` (export store +
-fleet service), and ``python -m mx_rcnn_tpu.tools.loadgen`` (closed/open
-loop + fleet load generation, BENCH-style JSON).
+fleet service), ``python -m mx_rcnn_tpu.tools.loadgen`` (closed/open
+loop + fleet load generation, BENCH-style JSON), and
+``python -m mx_rcnn_tpu.tools.bulk`` (corpus scoring + the kill/resume
+acceptance protocol).
 """
 
+from mx_rcnn_tpu.serve.bulk import (BulkRunner, BulkSink,  # noqa: F401
+                                    BulkSinkMismatch)
 from mx_rcnn_tpu.serve.engine import ServingEngine  # noqa: F401
 from mx_rcnn_tpu.serve.export import ExportStore  # noqa: F401
 from mx_rcnn_tpu.serve.fleet import (FleetRouter, ReplicaManager,  # noqa: F401

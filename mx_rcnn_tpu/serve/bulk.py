@@ -1,0 +1,520 @@
+"""Offline bulk-inference plane: StreamLoader-fed fleet scoring with
+exactly-once sink accounting.
+
+No reference equivalent — the reference scores a corpus through a
+synchronous single-GPU eval loop; this repo's serving fleet (PR 8) had
+no way to drive a large corpus through its export-warmed replicas.  This
+module closes ROADMAP item 5's creative half: the streaming input plane
+(topology-invariant epoch plan, bounded decode cache, double-buffered
+staging — ``data/loader.py — StreamTestLoader`` + ``data/staging.py``)
+feeds the fleet router's bucket lanes, and results commit to sharded
+JSONL sinks with the PR-6/7 manifest-cursor discipline pointed at
+inference:
+
+* **admission** — the feeder walks the deterministic corpus plan and
+  ``submit_prepared``\\ s each fp32 canvas row into its bucket lane
+  (``serve/fleet.py``), bounded by ``bulk.max_inflight`` in-flight
+  images (backpressure: the feeder blocks, queues never grow past the
+  shed watermark);
+* **scoring** — the production request path end to end: per-bucket
+  coalescing into static micro-batches, the bit-equality-pinned
+  postprocess, ``detections_from_keep`` demux, fleet-wide
+  terminate-exactly-once accounting.  A replica death reroutes; a
+  terminal FAILED/SHED resubmits (``bulk.retries``), and an exhausted
+  budget aborts the RUN, never drops an image;
+* **commit** — results land in plan order: shard ``k`` holds plan
+  batches ``[k*S, (k+1)*S)`` (``S = bulk.shard_batches``) and commits
+  via tmp → fsync → rename → dir-fsync ONLY when every one of its
+  images is terminal and every earlier shard is committed.  A SIGKILL
+  anywhere leaves a contiguous committed prefix and nothing else;
+* **resume** — the sink manifest (corpus fingerprint, plan geometry,
+  serving knobs, quant tag) is the admission check — a cursor from a
+  different corpus/batch-size/recipe is REFUSED — and the cursor IS the
+  committed-shard prefix: a restarted run recomputes the plan, skips
+  the committed batches, and produces byte-identical shards to the
+  uninterrupted control (pinned by tests/test_bulk.py and measured by
+  ``tools/bulk.py --protocol kill_resume``).
+
+Obs gauges (``bulk.*``): imgs_per_s, inflight, committed_shards,
+committed_images, retries counters + the sink_commit_ms histogram.
+Architecture + measured numbers: docs/SERVING.md "Bulk tier".
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.serve.queue import EXPIRED, FAILED, SERVED, SHED
+from mx_rcnn_tpu.utils.checkpoint import _atomic_write
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+MANIFEST = "MANIFEST.json"
+
+
+class BulkSinkMismatch(ValueError):
+    """The sink directory's manifest disagrees with this run's corpus /
+    plan / serving recipe — resuming would splice incompatible results
+    (the misaligned-cursor rejection)."""
+
+
+class BulkAborted(RuntimeError):
+    """An image exhausted its resubmit budget (or the fleet lost every
+    replica for good): the run stops loudly with accounting intact
+    instead of committing a corpus with holes."""
+
+
+def corpus_fingerprint(cfg: Config, roidb, seed: int,
+                       batch_images: int, model: str = None) -> str:
+    """Identity of (corpus, plan geometry, model, serving semantics):
+    sha256 over the roidb record geometry + every knob that changes
+    either the plan or the scored bytes — including the proposal-stage
+    sizes (different pre/post-NMS counts are different programs
+    producing different detections) and the ``model`` identity string
+    (checkpoint prefix@epoch or random-init@seed — resuming a sink with
+    different weights would splice two models' detections).  Two runs
+    may resume each other's sinks iff this matches (BulkSink
+    admission)."""
+    recs = [(int(r.get("index", i)), os.path.basename(r["image"]),
+             int(r["height"]), int(r["width"]),
+             bool(r.get("flipped", False)))
+            for i, r in enumerate(roidb)]
+    ident = {
+        "records": recs,
+        "seed": int(seed),
+        "batch_images": int(batch_images),
+        "model": model,
+        "bucket": {"scale": cfg.bucket.scale,
+                   "max_size": cfg.bucket.max_size,
+                   "shapes": [list(b) for b in cfg.bucket.shapes]},
+        "serve": {"batch_size": cfg.serve.batch_size,
+                  "nms": cfg.test.nms,
+                  "score_thresh": cfg.serve.score_thresh,
+                  "num_classes": cfg.num_classes,
+                  "rpn_pre_nms_top_n": cfg.test.rpn_pre_nms_top_n,
+                  "rpn_post_nms_top_n": cfg.test.rpn_post_nms_top_n},
+        "quant": _quant_tag(cfg),
+    }
+    return hashlib.sha256(
+        json.dumps(ident, sort_keys=True).encode()).hexdigest()
+
+
+def _quant_tag(cfg: Config) -> Optional[str]:
+    q = cfg.quant
+    if not q.enabled:
+        return None
+    return f"{q.dtype}:{q.mode}:{q.estimator}:{q.weight_bits}"
+
+
+class BulkSink:
+    """Sharded JSONL result sink with atomic commits and a
+    committed-prefix resume cursor.
+
+    Layout: ``MANIFEST.json`` + ``shard-<k>.jsonl`` files.  The manifest
+    is written first (atomically); each shard lands whole via
+    ``utils/checkpoint.py — _atomic_write`` (tmp → fsync → rename →
+    dir-fsync), so under SIGKILL a shard either exists completely or not
+    at all — there is no torn-shard state to detect.  Commits arrive in
+    order (the runner's committer thread), so the committed set is
+    always the prefix ``0..n-1``; a gap means foreign interference and
+    is refused.
+    """
+
+    def __init__(self, root: str, manifest: Optional[Dict] = None):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        mpath = os.path.join(root, MANIFEST)
+        existing = None
+        if os.path.exists(mpath):
+            with open(mpath) as f:
+                existing = json.load(f)
+        if manifest is None:
+            if existing is None:
+                raise ValueError(f"no manifest at {mpath} and none given")
+            self.manifest = existing
+        elif existing is None:
+            self.manifest = dict(manifest)
+            _atomic_write(mpath, (json.dumps(self.manifest, indent=1,
+                                             sort_keys=True) + "\n").encode())
+        else:
+            mism = [k for k in manifest
+                    if existing.get(k) != manifest[k]]
+            if mism:
+                raise BulkSinkMismatch(
+                    f"sink {root} was written by a different run: manifest "
+                    f"keys {sorted(mism)} disagree (e.g. "
+                    f"{mism[0]}={existing.get(mism[0])!r} vs "
+                    f"{manifest[mism[0]]!r}) — resuming would splice "
+                    "incompatible results; point --out_dir elsewhere or "
+                    "rebuild with the recorded recipe")
+            self.manifest = existing
+        # a killed run can leave one orphaned .tmp (pre-rename); it is
+        # dead weight, never data — clean it so the dir holds only
+        # committed shards
+        for name in os.listdir(root):
+            if name.endswith(".tmp"):
+                os.unlink(os.path.join(root, name))
+
+    @staticmethod
+    def shard_name(k: int) -> str:
+        return f"shard-{k:05d}.jsonl"
+
+    def shard_path(self, k: int) -> str:
+        return os.path.join(self.root, self.shard_name(k))
+
+    def committed_shards(self) -> int:
+        """Length of the contiguous committed prefix (the resume
+        cursor).  A non-contiguous shard set is refused — in-order
+        commits cannot produce one, so a gap means the directory was
+        tampered with or mixes two runs."""
+        ids = sorted(int(n[len("shard-"):-len(".jsonl")])
+                     for n in os.listdir(self.root)
+                     if n.startswith("shard-") and n.endswith(".jsonl"))
+        if ids != list(range(len(ids))):
+            raise BulkSinkMismatch(
+                f"sink {self.root} holds a non-contiguous shard set "
+                f"{ids} — commits are strictly in-order, so this "
+                "directory mixes runs or lost a shard")
+        return len(ids)
+
+    def commit(self, k: int, lines: List[str]) -> int:
+        """Atomically land shard ``k``; returns bytes written."""
+        data = ("\n".join(lines) + "\n").encode() if lines else b""
+        _atomic_write(self.shard_path(k), data)
+        return len(data)
+
+    def read_lines(self, k: int) -> List[str]:
+        with open(self.shard_path(k)) as f:
+            return f.read().splitlines()
+
+
+def detections_line(index: int, dets: Dict[int, np.ndarray]) -> str:
+    """One canonical JSONL line per image: ``{"i": corpus_index,
+    "dets": {class_id: [[x1, y1, x2, y2, score], ...]}}`` in raw image
+    coordinates.  Canonical (sorted keys, fixed separators, full float
+    repr) so identical detections serialize to identical BYTES — the
+    unit the kill/resume bit-identity invariant is stated in."""
+    # ndarray.tolist() yields the identical Python floats float(v)
+    # would (float32 → float64 is exact) at C speed — serialization is
+    # per-image hot-path work for the committer AND the baseline client
+    out = {str(c): np.asarray(arr).tolist()
+           for c, arr in sorted(dets.items())}
+    return json.dumps({"i": int(index), "dets": out},
+                      sort_keys=True, separators=(",", ":"))
+
+
+def auto_inflight(cfg: Config) -> int:
+    """The backpressure bound: ``bulk.max_inflight``, or (when 0)
+    2 full micro-batches per replica, clamped under the per-lane shed
+    watermark so steady-state single-bucket bulk traffic never sheds
+    even when JSQ lands every image on one replica's lane."""
+    n = cfg.bulk.max_inflight
+    if n > 0:
+        return n
+    n = 2 * cfg.serve.batch_size * max(cfg.fleet.replicas, 1)
+    return max(min(n, cfg.serve.shed_watermark - 1), 1)
+
+
+class BulkRunner:
+    """Drive one corpus pass: feed → score → in-order shard commit.
+
+    ``router`` is anything with the prepared-admission surface
+    (``FleetRouter`` or a bare ``ServingEngine``).  ``fault`` (tests and
+    the kill/resume protocol) is called with each shard index AFTER its
+    commit — ``kill@shard=K`` SIGKILLs the process there, leaving the
+    sink's committed prefix as the only trace.
+    """
+
+    def __init__(self, router, loader, sink: BulkSink, cfg: Config,
+                 registry=None,
+                 fault: Optional[Callable[[int], None]] = None):
+        self.router = router
+        self.loader = loader
+        self.sink = sink
+        self.cfg = cfg
+        self.rec = registry
+        self.fault = fault
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._inflight = threading.BoundedSemaphore(auto_inflight(cfg))
+        # per-batch result slots, keyed by PLAN batch index:
+        # {bi: [line_or_None] * rows}; a batch leaves the dict when its
+        # shard commits, so memory holds at most ~shard_batches batches
+        self._slots: Dict[int, List[Optional[str]]] = {}
+        self._pending: Dict[int, int] = {}
+        self._complete: set = set()
+        self._error: Optional[BaseException] = None
+        self._retry_q: List[Tuple] = []
+        self.retries = 0
+        self.committed_shards = 0
+        self.committed_images = 0
+
+    # ------------------------------------------------------------------
+    # plan bookkeeping
+    # ------------------------------------------------------------------
+
+    def _plan_geometry(self) -> Tuple[List[int], int]:
+        plan = self.loader._plan(0, self.loader.batch_images)
+        sizes = [len(idx) for _, idx in plan]
+        return sizes, sum(sizes)
+
+    # ------------------------------------------------------------------
+    # request completion (runs on dispatcher / router / retry threads)
+    # ------------------------------------------------------------------
+
+    def _on_done(self, bi: int, j: int, corpus_i: int, data, im_info,
+                 bucket, attempt: int, req) -> None:
+        state = req.state
+        if state == SERVED:
+            # store the raw result; the COMMITTER thread serializes —
+            # this callback often runs on a bucket dispatcher, which
+            # should get back to the model, and the committer's
+            # serialization overlaps its own fsync waits
+            with self._cond:
+                slot = self._slots.get(bi)
+                if slot is not None and slot[j] is None:
+                    slot[j] = (corpus_i, req.result or {})
+                    self._pending[bi] -= 1
+                    if self._pending[bi] == 0:
+                        self._complete.add(bi)
+                self._cond.notify_all()
+            self._inflight.release()
+            return
+        if state in (FAILED, SHED) and attempt < self.cfg.bulk.retries:
+            # resubmit off-thread: a SHED can terminate synchronously
+            # inside submit_prepared, and retrying inline from this
+            # callback (often a bucket dispatcher thread) would recurse
+            # and busy-spin the lane that is backed up
+            with self._cond:
+                self._retry_q.append((bi, j, corpus_i, data, im_info,
+                                      bucket, attempt + 1))
+                self.retries += 1
+                self._cond.notify_all()
+            if self.rec is not None:
+                self.rec.inc("bulk.retries")
+            return
+        err = req.error or RuntimeError(f"terminal state {state}")
+        with self._cond:
+            if self._error is None:
+                self._error = BulkAborted(
+                    f"image {corpus_i} (plan batch {bi} row {j}) "
+                    f"terminated {state} after {attempt + 1} attempt(s): "
+                    f"{err}")
+            self._cond.notify_all()
+        self._inflight.release()
+
+    def _submit(self, bi: int, j: int, corpus_i: int, data, im_info,
+                bucket, attempt: int) -> None:
+        req = self.router.submit_prepared(data, im_info, bucket,
+                                          timeout_ms=0)
+        req.add_done_callback(
+            lambda done, a=(bi, j, corpus_i, data, im_info, bucket,
+                            attempt): self._on_done(*a, done))
+
+    def _retry_worker(self) -> None:
+        backoff = 0.01
+        while True:
+            with self._cond:
+                while not self._retry_q and self._error is None \
+                        and not self._done_feeding_and_committed():
+                    self._cond.wait(timeout=0.2)
+                if self._error is not None \
+                        or (not self._retry_q
+                            and self._done_feeding_and_committed()):
+                    return
+                item = self._retry_q.pop(0)
+            # pace resubmits: the usual cause is a replica mid-relaunch
+            # or a momentarily full lane — hammering helps neither
+            time.sleep(min(backoff * item[-1], 0.25))
+            self._submit(*item)
+
+    def _done_feeding_and_committed(self) -> bool:
+        return self._feeding_done and self.committed_shards >= self._n_shards
+
+    # ------------------------------------------------------------------
+    # committer (one thread: commits are strictly in order)
+    # ------------------------------------------------------------------
+
+    def _committer(self, batch_sizes: List[int], t0: float) -> None:
+        S = max(self.cfg.bulk.shard_batches, 1)
+        n_batches = len(batch_sizes)
+        try:
+            for k in range(self.committed_shards, self._n_shards):
+                lo, hi = k * S, min((k + 1) * S, n_batches)
+                with self._cond:
+                    while not all(b in self._complete
+                                  for b in range(lo, hi)):
+                        if self._error is not None:
+                            return
+                        self._cond.wait(timeout=0.5)
+                    results = []
+                    for b in range(lo, hi):
+                        results.extend(self._slots.pop(b))
+                        self._pending.pop(b, None)
+                        self._complete.discard(b)
+                lines: List[str] = [detections_line(ci, res)
+                                    for ci, res in results]
+                tc = time.perf_counter()
+                self.sink.commit(k, lines)  # fsync OUTSIDE the lock
+                commit_ms = (time.perf_counter() - tc) * 1e3
+                with self._cond:
+                    self.committed_shards = k + 1
+                    self.committed_images += len(lines)
+                    self._cond.notify_all()
+                if self.rec is not None:
+                    self.rec.observe("bulk.sink_commit_ms", commit_ms)
+                    self.rec.set_gauge("bulk.committed_shards",
+                                       self.committed_shards)
+                    self.rec.inc("bulk.committed_images", len(lines))
+                    self.rec.set_gauge(
+                        "bulk.imgs_per_s",
+                        round(self.committed_images
+                              / max(time.perf_counter() - t0, 1e-9), 2))
+                if self.fault is not None:
+                    self.fault(k)
+        except BaseException as e:  # noqa: BLE001 — re-raised in run()
+            with self._cond:
+                if self._error is None:
+                    self._error = e
+                self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # the run
+    # ------------------------------------------------------------------
+
+    def run(self) -> Dict:
+        """One corpus pass (resuming from the sink's committed prefix);
+        returns the accounting record.  Raises :class:`BulkAborted` (or
+        the underlying error) instead of ever under-counting."""
+        from mx_rcnn_tpu.data.staging import DeviceStager
+
+        cfg = self.cfg
+        batch_sizes, planned_images = self._plan_geometry()
+        n_batches = len(batch_sizes)
+        S = max(cfg.bulk.shard_batches, 1)
+        self._n_shards = -(-n_batches // S) if n_batches else 0
+        done = self.sink.committed_shards()
+        skip_batches = min(done * S, n_batches)
+        resumed_images = sum(batch_sizes[:skip_batches])
+        self.committed_shards = done
+        self.committed_images = 0
+        self._feeding_done = skip_batches >= n_batches
+        self.loader.set_epoch(0)
+        if skip_batches:
+            self.loader.skip_next_batches(skip_batches)
+            logger.info("bulk resume: %d shard(s) committed — skipping "
+                        "%d plan batches (%d images) already accounted",
+                        done, skip_batches, resumed_images)
+
+        t0 = time.perf_counter()
+        committer = threading.Thread(
+            target=self._committer, args=(batch_sizes, t0),
+            name="bulk-committer", daemon=True)
+        committer.start()
+        retrier = threading.Thread(target=self._retry_worker,
+                                   name="bulk-retry", daemon=True)
+        retrier.start()
+
+        stager = None
+        try:
+            if not self._feeding_done:
+                # double-buffered read-ahead (data/staging.py): the
+                # loader's decode/assembly runs stage_depth batches
+                # ahead on the stager thread while this thread feeds
+                # lanes — host-side place (rows ship to replicas, not
+                # to one device)
+                stager = DeviceStager(iter(self.loader), lambda b: b,
+                                      depth=max(cfg.data.stage_depth, 1),
+                                      rec=self.rec)
+                bi = skip_batches
+                for batch, indices, scales in stager:
+                    bucket = tuple(batch.images.shape[1:3])
+                    with self._cond:
+                        if self._error is not None:
+                            break
+                        self._slots[bi] = [None] * len(indices)
+                        self._pending[bi] = len(indices)
+                    if self.rec is not None:  # once per batch, not row
+                        self.rec.set_gauge(
+                            "bulk.inflight",
+                            auto_inflight(cfg) - self._inflight._value)
+                    for j, corpus_i in enumerate(indices):
+                        while not self._inflight.acquire(timeout=1.0):
+                            if self._error is not None:
+                                raise self._error
+                        # row VIEWS, not copies: an in-flight row pins
+                        # its batch buffer, but at most
+                        # ~inflight/batch_images + stage_depth buffers
+                        # are ever live (the backpressure bound), and a
+                        # per-row memcpy (0.9 MB at the 240x320 canvas)
+                        # measurably taxes a 1-core host
+                        self._submit(bi, j, int(corpus_i),
+                                     batch.images[j],
+                                     batch.im_info[j], bucket, 0)
+                    bi += 1
+                with self._cond:
+                    self._feeding_done = True
+                    self._cond.notify_all()
+            committer.join()
+            retrier.join()
+        finally:
+            if stager is not None:
+                stager.close()
+            with self._cond:
+                self._feeding_done = True
+                self._cond.notify_all()
+        if self._error is not None:
+            raise self._error
+        wall = time.perf_counter() - t0
+        accounted = resumed_images + self.committed_images
+        rate = self.committed_images / max(wall, 1e-9)
+        if self.rec is not None:
+            self.rec.set_gauge("bulk.imgs_per_s", round(rate, 2))
+            self.rec.set_gauge("bulk.inflight", 0)
+        return {
+            "planned_images": planned_images,
+            "planned_batches": n_batches,
+            "shards": self._n_shards,
+            "resumed_shards": done,
+            "resumed_images": resumed_images,
+            "scored_images": self.committed_images,
+            "accounted_images": accounted,
+            "lost": planned_images - accounted,
+            "retries": self.retries,
+            "wall_s": round(wall, 3),
+            "imgs_per_sec": round(rate, 2),
+        }
+
+
+def make_sink_manifest(cfg: Config, roidb, seed: int,
+                       batch_images: int, model: str = None) -> Dict:
+    """The sink admission record: everything a resume must agree on.
+    ``model`` is the weights identity (``<prefix>@<epoch>`` or
+    ``random-init@seed=N`` — ``tools/bulk.py`` passes it); the
+    fingerprint folds it in so a resume under different weights is
+    refused, not spliced."""
+    return {
+        "version": 1,
+        "corpus": corpus_fingerprint(cfg, roidb, seed, batch_images,
+                                     model=model),
+        "images": len(roidb),
+        "batch_images": int(batch_images),
+        "shard_batches": int(cfg.bulk.shard_batches),
+        "seed": int(seed),
+        "model": model,
+        "serve_batch_size": cfg.serve.batch_size,
+        "nms_thresh": cfg.test.nms,
+        "score_thresh": cfg.serve.score_thresh,
+        "rpn_pre_nms_top_n": cfg.test.rpn_pre_nms_top_n,
+        "rpn_post_nms_top_n": cfg.test.rpn_post_nms_top_n,
+        "quant": _quant_tag(cfg),
+    }

@@ -154,6 +154,43 @@ class ServingEngine:
             self.metrics.count("shed")
         return req
 
+    def submit_prepared(self, data: np.ndarray, im_info: np.ndarray,
+                        bucket: Tuple[int, int],
+                        timeout_ms: float = None) -> ServeRequest:
+        """Bulk-plane admission seam (``serve/bulk.py``): admit one
+        ALREADY-preprocessed image — ``data`` is the (bh, bw, 3) fp32
+        padded canvas exactly as :meth:`preprocess` would produce it
+        (the streaming loader's fp32 rows are pixel-identical by
+        construction — pinned by tests/test_bulk.py), ``im_info`` its
+        (3,) record.  Skips the dims estimate and the resize; everything
+        downstream — watermark shed, bucket queue, coalescing, demux,
+        exactly-once accounting — is the production request path, so the
+        bulk plane cannot disagree with online serving on semantics."""
+        bucket = tuple(bucket)
+        if bucket not in self.queues:
+            raise ValueError(f"bucket {bucket} is not a configured shape "
+                             f"bucket {sorted(self.queues)}")
+        data = np.asarray(data)
+        if data.shape != bucket + (3,) or data.dtype != np.float32:
+            # the compose/forward contract is the fp32 mean-subtracted
+            # canvas; a uint8 raw row would silently skip normalization
+            raise ValueError(
+                f"prepared image must be float32 {bucket + (3,)}, got "
+                f"{data.dtype} {data.shape} (build the loader with "
+                f"raw_images=False)")
+        now = time.monotonic()
+        t = (self.cfg.serve.default_timeout_ms if timeout_ms is None
+             else timeout_ms)
+        deadline = now + t / 1000.0 if t and t > 0 else None
+        req = ServeRequest(data, np.asarray(im_info, np.float32), bucket,
+                           deadline, now)
+        self._trace_admit(req)
+        self.metrics.count("submitted")
+        if self._closed or not self.queues[bucket].offer(req):
+            req._finish(SHED)
+            self.metrics.count("shed")
+        return req
+
     @staticmethod
     def _trace_admit(req: ServeRequest) -> None:
         """Open the request's trace interval (obs/trace.py; no-op unless

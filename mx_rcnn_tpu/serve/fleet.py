@@ -84,14 +84,20 @@ class FleetRequest(ServeRequest):
     a drained burst holds no pixel memory.
     """
 
-    __slots__ = ("attempts", "tried", "replica_id")
+    __slots__ = ("attempts", "tried", "replica_id", "prepared")
 
     def __init__(self, image: np.ndarray, deadline: Optional[float],
-                 now: float):
-        super().__init__(image, None, None, deadline, now)
+                 now: float, im_info: np.ndarray = None,
+                 bucket: Tuple[int, int] = None, prepared: bool = False):
+        super().__init__(image, im_info, bucket, deadline, now)
         self.attempts = 0          # dispatches so far (1 = no reroute)
         self.tried: set = set()    # replica ids already dispatched to
         self.replica_id: Optional[int] = None  # last dispatch target
+        # bulk plane (serve/bulk.py): image is the ALREADY-preprocessed
+        # fp32 bucket canvas and im_info its record — dispatch goes
+        # through ``ServingEngine.submit_prepared`` (a reroute re-offers
+        # the same canvas; there is no raw image to re-resize)
+        self.prepared = prepared
 
 
 class Replica:
@@ -377,6 +383,25 @@ class FleetRouter:
         self._dispatch(freq)
         return freq
 
+    def submit_prepared(self, data: np.ndarray, im_info: np.ndarray,
+                        bucket: Tuple[int, int],
+                        timeout_ms: float = None) -> FleetRequest:
+        """Bulk-plane admission (``serve/bulk.py``): route one
+        ALREADY-preprocessed canvas into its bucket lane fleet-wide —
+        same JSQ spread, deadline authority, reroute and exactly-once
+        accounting as :meth:`submit`, with the per-dispatch preprocess
+        skipped (the canvas was built once, by the streaming loader)."""
+        now = time.monotonic()
+        t = (self.cfg.serve.default_timeout_ms if timeout_ms is None
+             else timeout_ms)
+        deadline = now + t / 1000.0 if t and t > 0 else None
+        freq = FleetRequest(np.asarray(data), deadline, now,
+                            im_info=np.asarray(im_info, np.float32),
+                            bucket=tuple(bucket), prepared=True)
+        self.metrics.count("submitted")
+        self._dispatch(freq)
+        return freq
+
     def detect(self, img: np.ndarray, timeout_ms: float = None):
         req = self.submit(img, timeout_ms=timeout_ms)
         wait_s = None
@@ -451,7 +476,12 @@ class FleetRouter:
             return
         remaining_ms = (0.0 if freq.deadline is None
                         else max((freq.deadline - now) * 1000.0, 0.001))
-        inner = eng.submit(freq.image, timeout_ms=remaining_ms)
+        if freq.prepared:
+            inner = eng.submit_prepared(freq.image, freq.im_info,
+                                        freq.bucket,
+                                        timeout_ms=remaining_ms)
+        else:
+            inner = eng.submit(freq.image, timeout_ms=remaining_ms)
         inner.add_done_callback(
             lambda done, _freq=freq, _eng=eng:
             self._on_inner_done(_freq, done, _eng))

@@ -66,6 +66,30 @@ def _vm_rss_mb() -> float:
     return 0.0
 
 
+def _canvas_overrides(args) -> dict:
+    """``--coco_canvas``: run the pipeline at the PRODUCTION bucket
+    canvas (PR 5's sublane-friendly 640x1024 / ref scale 600 capped at
+    1000) instead of the 240x320 rehearsal canvas — the honest per-byte
+    pixel-rate leg docs/DATA.md records (a 240x320 rate says nothing
+    about decoding COCO-resolution pixels)."""
+    if not getattr(args, "coco_canvas", False):
+        return {}
+    return {"bucket__scale": 600, "bucket__max_size": 1000,
+            "bucket__shapes": ((640, 1024), (1024, 640))}
+
+
+def _source_size_kw(args) -> dict:
+    """Synthetic source-image size for the materialized set:
+    ``--image_size HxW`` explicit, or 480x640 (the modal COCO source
+    size) under ``--coco_canvas``."""
+    if getattr(args, "image_size", None):
+        h, w = (int(x) for x in args.image_size.split("x"))
+        return {"image_size": (h, w)}
+    if getattr(args, "coco_canvas", False):
+        return {"image_size": (480, 640)}
+    return {}
+
+
 def _build(args, shard=None):
     """(cfg, roidb, loader) for the train split streaming epoch."""
     from mx_rcnn_tpu.config import generate_config
@@ -74,6 +98,7 @@ def _build(args, shard=None):
 
     over = ({"dataset__dataset_path": args.dataset_path}
             if args.dataset_path else {})
+    over.update(_canvas_overrides(args))
     cfg = generate_config(
         args.network, args.dataset,
         dataset__root_path=args.root_path,
@@ -84,6 +109,7 @@ def _build(args, shard=None):
         default__decode_procs=args.decode_procs,
         obs__enabled=False, **over)
     kw = {"num_images": args.num_images}
+    kw.update(_source_size_kw(args))
     _, roidb = load_gt_roidb(cfg, training=True, **kw)
     bh, bw = cfg.bucket.shapes[0]
     cache = cache_from_config(cfg, n_images=len(roidb),
@@ -135,7 +161,10 @@ def _spawn_shard_rig(args):
                "--batch_images", str(args.batch_images),
                "--num_workers", str(args.num_workers),
                "--ram_ceiling_mb", str(args.ram_ceiling_mb),
-               "--seed", str(args.seed)]
+               "--seed", str(args.seed),
+               *(["--coco_canvas"] if args.coco_canvas else []),
+               *(["--image_size", args.image_size]
+                 if args.image_size else [])]
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         procs.append(subprocess.Popen(cmd, env=env,
                                       stdout=subprocess.PIPE,
@@ -257,11 +286,13 @@ def run_eval_leg(args, record):
 
     over = ({"dataset__dataset_path": args.dataset_path}
             if args.dataset_path else {})
+    over.update(_canvas_overrides(args))
     cfg = generate_config(args.network, args.dataset,
                           dataset__root_path=args.root_path,
                           data__ram_ceiling_mb=args.ram_ceiling_mb, **over)
     _, roidb = load_gt_roidb(cfg, training=False,
-                             num_images=args.test_images)
+                             num_images=args.test_images,
+                             **_source_size_kw(args))
     loader = TestLoader(roidb, cfg, batch_images=args.batch_images,
                         num_workers=args.num_workers)
     t0 = time.perf_counter()
@@ -357,6 +388,15 @@ def main(argv=None) -> int:
                    help="simulated device step per batch in the "
                         "streaming epoch (0 = pure input-plane rate)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--coco_canvas", action="store_true",
+                   help="run at the PRODUCTION 640x1024 bucket canvas "
+                        "(scale 600/1000) over 480x640 source images — "
+                        "the COCO-resolution pixel-rate rehearsal "
+                        "(docs/DATA.md); defaults --dataset_path to a "
+                        "sibling *_coco dir so the cardinality "
+                        "rehearsal's PNG stamp survives")
+    p.add_argument("--image_size", default=None, metavar="HxW",
+                   help="synthetic source-image size override")
     p.add_argument("--control_images", type=int, default=64)
     p.add_argument("--control_epochs", type=int, default=2)
     p.add_argument("--skip_control", action="store_true")
@@ -383,6 +423,13 @@ def main(argv=None) -> int:
             args.dataset_path = os.path.join(
                 args.root_path, f"{args.dataset}_smoke")
 
+    if args.coco_canvas and args.dataset_path is None:
+        # own directory: a different source-size spec regenerating
+        # inside the 10k cardinality set's dir would invalidate its
+        # PNG stamp (same rule as --smoke)
+        args.dataset_path = os.path.join(args.root_path,
+                                         f"{args.dataset}_coco")
+
     if args.worker:
         return run_worker(args)
 
@@ -390,6 +437,7 @@ def main(argv=None) -> int:
               "dataset": args.dataset,
               "num_images": args.num_images,
               "batch_images": args.batch_images,
+              "coco_canvas": bool(args.coco_canvas),
               "smoke": bool(args.smoke)}
     t_all = time.perf_counter()
 

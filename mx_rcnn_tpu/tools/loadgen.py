@@ -236,6 +236,35 @@ def make_stub_run_fn(cfg: Config, model_ms: float, seed: int = 0):
     return run_fn
 
 
+def make_content_stub_run_fn(cfg: Config, model_ms: float = 0.0):
+    """Deterministic CONTENT-DEPENDENT stub (tests/test_bulk.py + the
+    bulk sink's SIGKILL rig): every output row is a pure function of
+    that row's pixels alone, so (a) identical images score identically
+    regardless of micro-batch composition or replica — the property the
+    bulk plane's byte-identity invariant rests on — and (b) two
+    different images produce different lines, so a mis-ordered or
+    mis-slotted sink cannot pass the bit-identity comparison."""
+    r = cfg.test.rpn_post_nms_top_n
+    c = cfg.num_classes
+
+    def run_fn(images, im_info):
+        if model_ms:
+            time.sleep(model_ms / 1000.0)
+        n = images.shape[0]
+        boxes = np.zeros((n, r, 4 * c), np.float32)
+        scores = np.zeros((n, r, c), np.float32)
+        keep = np.zeros((n, c, r), bool)
+        for j in range(n):
+            m = np.float32(np.abs(images[j]).sum())
+            x = np.float32(m % np.float32(37.0))
+            boxes[j, 0, 4:8] = [x, x + 1.0, x + 5.0, x + 7.0]
+            scores[j, 0, 1] = np.float32(0.5) + x / np.float32(100.0)
+            keep[j, 1, 0] = True
+        return boxes, scores, keep
+
+    return run_fn
+
+
 def _build_fleet(cfg: Config, replicas: int, model, variables, *,
                  export_root: str = None, stub_ms: float = None):
     from mx_rcnn_tpu.serve.fleet import build_fleet

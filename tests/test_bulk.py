@@ -1,0 +1,410 @@
+"""Bulk-inference plane tests (ISSUE 11): StreamTestLoader eval-mode
+plan, the prepared-admission seam, sink atomicity + misaligned-cursor
+rejection, kill-mid-corpus resume bit-identity, and the exactly-once
+accounting invariant under a replica eject.
+
+Runner/fleet tests use the content-dependent stub
+(``loadgen.make_content_stub_run_fn`` — every output row a pure
+function of its own pixels, so byte-identity comparisons are
+meaningful) over millisecond stub replicas: no model compiles anywhere
+in this file.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data import load_gt_roidb
+from mx_rcnn_tpu.data.image import imread_rgb, resize_to_bucket
+from mx_rcnn_tpu.data.loader import StreamTestLoader
+from mx_rcnn_tpu.serve.bulk import (BulkAborted, BulkRunner, BulkSink,
+                                    BulkSinkMismatch, auto_inflight,
+                                    corpus_fingerprint, detections_line,
+                                    make_sink_manifest)
+from mx_rcnn_tpu.serve.engine import ServingEngine
+from mx_rcnn_tpu.serve.fleet import build_fleet
+from mx_rcnn_tpu.tools.loadgen import make_content_stub_run_fn
+
+
+def _cfg(tmp_root, **kw):
+    over = dict(
+        dataset__root_path=str(tmp_root),
+        dataset__dataset_path=os.path.join(str(tmp_root), "synthetic"),
+        bucket__scale=128, bucket__max_size=160,
+        bucket__shapes=((128, 160), (160, 128)),
+        test__rpn_pre_nms_top_n=512, test__rpn_post_nms_top_n=64,
+        serve__batch_size=2, serve__max_delay_ms=5.0,
+        fleet__replicas=2, fleet__health_interval_s=0.2,
+        bulk__shard_batches=2, data__streaming=True)
+    over.update(kw)
+    return generate_config("tiny", "synthetic", **over)
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """(cfg, roidb): a 16-image 128x160 synthetic corpus on disk."""
+    root = tmp_path_factory.mktemp("bulk_data")
+    cfg = _cfg(root)
+    _, roidb = load_gt_roidb(cfg, training=True, flip=False,
+                             num_images=16, image_size=(128, 160),
+                             max_objects=2)
+    return cfg, roidb
+
+
+def _stub_predictor(cfg):
+    from mx_rcnn_tpu.core.tester import Predictor
+
+    return Predictor(None, {}, cfg)
+
+
+def _stub_fleet(cfg, model_ms=0.0, run_fn=None):
+    factory = (lambda rid: run_fn) if run_fn is not None else (
+        lambda rid: make_content_stub_run_fn(cfg, model_ms))
+    return build_fleet(cfg, None, {}, run_fn_factory=factory)
+
+
+def _run_bulk(cfg, roidb, sink_dir, router=None, fault=None, seed=0,
+              batch_images=2):
+    own = router is None
+    if own:
+        router = _stub_fleet(cfg)
+    try:
+        loader = StreamTestLoader(roidb, cfg, batch_images=batch_images,
+                                  shuffle=False, seed=seed,
+                                  raw_images=False, num_workers=0)
+        sink = BulkSink(str(sink_dir),
+                        make_sink_manifest(cfg, roidb, seed, batch_images))
+        return BulkRunner(router, loader, sink, cfg, fault=fault).run()
+    finally:
+        if own:
+            router.close()
+
+
+# ---------------------------------------------------------------------------
+# StreamTestLoader: eval-mode plan
+# ---------------------------------------------------------------------------
+
+def test_stream_test_loader_covers_every_image_once(corpus):
+    cfg, roidb = corpus
+    loader = StreamTestLoader(roidb, cfg, batch_images=3, shuffle=False,
+                              num_workers=0)
+    # 16 images / batch 3 → 5 full + 1 tail of 1: the tail StreamLoader
+    # would drop must be a partial final batch here
+    plan = loader._plan(0, 3)
+    assert [len(idx) for _, idx in plan] == [3, 3, 3, 3, 3, 1]
+    assert len(loader) == 6
+    seen = []
+    loader.set_epoch(0)
+    for batch, indices, scales in loader:
+        assert batch.images.shape[0] == len(indices) == len(scales)
+        assert batch.images.dtype == np.uint8  # raw_images default
+        seen.extend(indices)
+    assert sorted(seen) == list(range(16))
+
+
+def test_stream_test_loader_skip_batches_resumes_identically(corpus):
+    cfg, roidb = corpus
+    mk = lambda: StreamTestLoader(roidb, cfg, batch_images=3,  # noqa: E731
+                                  shuffle=False, num_workers=0)
+    full, resumed = mk(), mk()
+    full.set_epoch(0)
+    ref = [(idx, b.im_info.copy()) for b, idx, _ in full]
+    resumed.set_epoch(0)
+    resumed.skip_next_batches(2)
+    got = [(idx, b.im_info.copy()) for b, idx, _ in resumed]
+    assert [i for i, _ in got] == [i for i, _ in ref[2:]]
+    for (_, a), (_, b) in zip(got, ref[2:]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_loader_fp32_rows_match_serve_preprocess(corpus):
+    """The prepared-admission contract: a raw_images=False loader row is
+    BIT-identical to what ``ServingEngine.preprocess`` would build for
+    the same source image — submit_prepared may skip the resize."""
+    cfg, roidb = corpus
+    loader = StreamTestLoader(roidb, cfg, batch_images=2, shuffle=False,
+                              raw_images=False, num_workers=0)
+    loader.set_epoch(0)
+    batch, indices, scales = next(iter(loader))
+    for j, i in enumerate(indices):
+        img = imread_rgb(roidb[i]["image"])
+        canvas, im_scale, bucket = resize_to_bucket(
+            img, cfg.network.pixel_means, cfg.bucket.scale,
+            cfg.bucket.max_size, [tuple(b) for b in cfg.bucket.shapes])
+        assert bucket == tuple(batch.images.shape[1:3])
+        np.testing.assert_array_equal(batch.images[j], canvas)
+        assert batch.im_info[j][2] == np.float32(im_scale)
+
+
+# ---------------------------------------------------------------------------
+# prepared admission seam
+# ---------------------------------------------------------------------------
+
+def test_submit_prepared_matches_submit(corpus):
+    cfg, roidb = corpus
+    run_fn = make_content_stub_run_fn(cfg)
+    engine = ServingEngine(_stub_predictor(cfg), cfg, run_fn=run_fn)
+    try:
+        img = imread_rgb(roidb[0]["image"])
+        via_submit = engine.detect(img, timeout_ms=0)
+        data, im_info, bucket = engine.preprocess(img)
+        via_prepared = engine.submit_prepared(
+            data, im_info, bucket, timeout_ms=0).wait(timeout=20.0)
+        assert sorted(via_submit) == sorted(via_prepared)
+        for c in via_submit:
+            np.testing.assert_array_equal(via_submit[c], via_prepared[c])
+    finally:
+        engine.close()
+
+
+def test_submit_prepared_refuses_wrong_shape_and_bucket(corpus):
+    cfg, _ = corpus
+    engine = ServingEngine(_stub_predictor(cfg), cfg,
+                           run_fn=make_content_stub_run_fn(cfg),
+                           start=False)
+    info = np.array([128, 160, 1.0], np.float32)
+    with pytest.raises(ValueError, match="float32"):
+        engine.submit_prepared(
+            np.zeros((128, 160, 3), np.uint8), info, (128, 160))
+    with pytest.raises(ValueError, match="bucket"):
+        engine.submit_prepared(
+            np.zeros((64, 64, 3), np.float32), info, (64, 64))
+
+
+# ---------------------------------------------------------------------------
+# sink: manifest admission + atomic commits
+# ---------------------------------------------------------------------------
+
+def test_sink_manifest_mismatch_rejected(tmp_path, corpus):
+    cfg, roidb = corpus
+    m = make_sink_manifest(cfg, roidb, seed=0, batch_images=2)
+    BulkSink(str(tmp_path), m)
+    BulkSink(str(tmp_path), dict(m))  # identical recipe resumes fine
+    with pytest.raises(BulkSinkMismatch, match="batch_images"):
+        BulkSink(str(tmp_path),
+                 make_sink_manifest(cfg, roidb, seed=0, batch_images=4))
+    with pytest.raises(BulkSinkMismatch, match="corpus"):
+        BulkSink(str(tmp_path),
+                 make_sink_manifest(cfg, roidb[:8], seed=0,
+                                    batch_images=2))
+    # different WEIGHTS may not resume this sink (they would splice two
+    # models' detections) ...
+    with pytest.raises(BulkSinkMismatch, match="model|corpus"):
+        BulkSink(str(tmp_path),
+                 make_sink_manifest(cfg, roidb, seed=0, batch_images=2,
+                                    model="ckpt/e2e@5"))
+    # ... nor may a different proposal-stage size (different programs,
+    # different detections)
+    with pytest.raises(BulkSinkMismatch, match="rpn_pre_nms|corpus"):
+        BulkSink(str(tmp_path), make_sink_manifest(
+            cfg.replace_in("test", rpn_pre_nms_top_n=128), roidb,
+            seed=0, batch_images=2))
+
+
+def test_corpus_fingerprint_tracks_recipe(corpus):
+    cfg, roidb = corpus
+    base = corpus_fingerprint(cfg, roidb, 0, 2)
+    assert base == corpus_fingerprint(cfg, roidb, 0, 2)
+    assert base != corpus_fingerprint(cfg, roidb, 1, 2)
+    assert base != corpus_fingerprint(cfg, roidb[:-1], 0, 2)
+    qcfg = cfg.replace_in("quant", enabled=True)
+    assert base != corpus_fingerprint(qcfg, roidb, 0, 2)
+
+
+def test_sink_commit_prefix_and_tmp_cleanup(tmp_path, corpus):
+    cfg, roidb = corpus
+    m = make_sink_manifest(cfg, roidb, 0, 2)
+    sink = BulkSink(str(tmp_path), m)
+    assert sink.committed_shards() == 0
+    sink.commit(0, [detections_line(0, {1: np.ones((1, 5))})])
+    sink.commit(1, [detections_line(1, {})])
+    assert sink.committed_shards() == 2
+    # an orphaned tmp (pre-rename kill) is cleaned at reopen, never data
+    orphan = os.path.join(str(tmp_path), "shard-00002.jsonl.tmp")
+    with open(orphan, "w") as f:
+        f.write("torn")
+    sink2 = BulkSink(str(tmp_path), m)
+    assert not os.path.exists(orphan)
+    assert sink2.committed_shards() == 2
+    # a gap means foreign interference: refuse, don't guess
+    with open(os.path.join(str(tmp_path), "shard-00005.jsonl"), "w"):
+        pass
+    with pytest.raises(BulkSinkMismatch, match="non-contiguous"):
+        sink2.committed_shards()
+
+
+# ---------------------------------------------------------------------------
+# runner: exactly-once accounting + resume bit-identity
+# ---------------------------------------------------------------------------
+
+def test_bulk_exactly_once_accounting(tmp_path, corpus):
+    cfg, roidb = corpus
+    stats = _run_bulk(cfg, roidb, tmp_path / "sink")
+    assert stats["planned_images"] == 16
+    assert stats["accounted_images"] == 16
+    assert stats["lost"] == 0
+    sink = BulkSink(str(tmp_path / "sink"))
+    seen = []
+    for k in range(sink.committed_shards()):
+        for line in sink.read_lines(k):
+            rec = json.loads(line)
+            seen.append(rec["i"])
+            assert "dets" in rec
+    assert sorted(seen) == sorted(
+        int(r.get("index", -1)) for r in roidb)
+
+
+def test_kill_mid_corpus_resume_is_bit_identical(tmp_path, corpus):
+    cfg, roidb = corpus
+    _run_bulk(cfg, roidb, tmp_path / "control")
+
+    class _Stop(Exception):
+        pass
+
+    def fault(shard):
+        if shard == 1:
+            raise _Stop()  # in-process stand-in for the SIGKILL rig
+
+    with pytest.raises(_Stop):
+        _run_bulk(cfg, roidb, tmp_path / "kr", fault=fault)
+    killed = BulkSink(str(tmp_path / "kr"))
+    assert killed.committed_shards() == 2  # shards 0..1 landed, then died
+    stats = _run_bulk(cfg, roidb, tmp_path / "kr")  # resume
+    assert stats["resumed_shards"] == 2
+    assert stats["accounted_images"] == 16
+    ctrl = BulkSink(str(tmp_path / "control"))
+    assert ctrl.committed_shards() == killed.committed_shards()
+    for k in range(ctrl.committed_shards()):
+        a = open(ctrl.shard_path(k), "rb").read()
+        b = open(killed.shard_path(k), "rb").read()
+        assert a == b, f"shard {k} differs after kill+resume"
+
+
+def test_misaligned_cursor_rejected_at_resume(tmp_path, corpus):
+    cfg, roidb = corpus
+    _run_bulk(cfg, roidb, tmp_path / "sink")
+    with pytest.raises(BulkSinkMismatch):
+        _run_bulk(cfg, roidb, tmp_path / "sink", batch_images=4)
+
+
+def test_accounting_under_replica_eject_mid_corpus(tmp_path, corpus):
+    """A replica dies mid-corpus: its stranded work FAILs → the router
+    reroutes → the runner's resubmit budget absorbs the transient — and
+    the final accounting still reads N in = N accounted, with the sink
+    byte-identical to an undisturbed control (per-image determinism
+    means an eject may change WHO scored an image, never the bytes)."""
+    cfg, roidb = corpus
+    _run_bulk(cfg, roidb, tmp_path / "control", batch_images=2)
+
+    router = _stub_fleet(cfg, model_ms=20.0)
+    try:
+        def fault(shard):
+            if shard == 0:  # mid-corpus: shards 1.. still to score
+                router.manager.replicas[0].engine.kill()
+
+        stats = _run_bulk(cfg, roidb, tmp_path / "ejected",
+                          router=router, fault=fault)
+        deadline = time.monotonic() + 10.0
+        while router.manager.ejects == 0 and time.monotonic() < deadline:
+            router.manager.tick()
+            time.sleep(0.05)
+        assert router.manager.ejects >= 1
+        assert stats["accounted_images"] == stats["planned_images"] == 16
+        assert stats["lost"] == 0
+    finally:
+        router.close()
+    ctrl, ej = BulkSink(str(tmp_path / "control")), \
+        BulkSink(str(tmp_path / "ejected"))
+    assert ej.committed_shards() == ctrl.committed_shards()
+    for k in range(ctrl.committed_shards()):
+        assert (open(ctrl.shard_path(k), "rb").read()
+                == open(ej.shard_path(k), "rb").read())
+
+
+def test_unservable_image_aborts_instead_of_dropping(tmp_path, corpus):
+    cfg, roidb = corpus
+    cfg = cfg.replace_in("bulk", retries=1)
+    cfg = cfg.replace_in("fleet", relaunch=False, reroute_retries=0)
+    router = _stub_fleet(cfg)
+    try:
+        for r in router.manager.replicas:
+            r.engine.kill()  # nothing left to serve
+        with pytest.raises(BulkAborted):
+            _run_bulk(cfg, roidb, tmp_path / "sink", router=router)
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# sink atomicity under a REAL SIGKILL
+# ---------------------------------------------------------------------------
+
+def test_sink_atomic_under_sigkill(tmp_path, corpus):
+    """A real SIGKILL mid-run leaves exactly a contiguous committed
+    prefix — every landed shard complete and parseable, no torn files —
+    and a fresh process resumes it to a complete sink."""
+    cfg, roidb = corpus
+    data_root = cfg.dataset.root_path
+    script = tmp_path / "child.py"
+    script.write_text(textwrap.dedent(f"""
+        import sys
+        sys.path.insert(0, {os.getcwd()!r})
+        from tests.test_bulk import _cfg, _run_bulk
+        from mx_rcnn_tpu.data import load_gt_roidb
+        from mx_rcnn_tpu.tools.bulk import parse_fault
+
+        cfg = _cfg({data_root!r})
+        _, roidb = load_gt_roidb(cfg, training=True, flip=False,
+                                 num_images=16, image_size=(128, 160),
+                                 max_objects=2)
+        fault = parse_fault(sys.argv[2] if len(sys.argv) > 2 else "")
+        _run_bulk(cfg, roidb, sys.argv[1], fault=fault)
+    """))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    sink_dir = tmp_path / "sink"
+    out = subprocess.run(
+        [sys.executable, str(script), str(sink_dir), "kill@shard=1"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == -signal.SIGKILL, out.stderr[-2000:]
+    sink = BulkSink(str(sink_dir))
+    n = sink.committed_shards()
+    assert n == 2
+    for k in range(n):
+        for line in sink.read_lines(k):
+            json.loads(line)  # every committed line is complete JSON
+    # resume in a fresh process → complete, exactly-once
+    out = subprocess.run(
+        [sys.executable, str(script), str(sink_dir)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    seen = []
+    sink = BulkSink(str(sink_dir))
+    for k in range(sink.committed_shards()):
+        seen += [json.loads(ln)["i"] for ln in sink.read_lines(k)]
+    assert sorted(seen) == sorted(int(r.get("index", -1)) for r in roidb)
+
+
+# ---------------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------------
+
+def test_bulk_config_section_and_auto_inflight():
+    cfg = generate_config("tiny", "synthetic", bulk__max_inflight=7,
+                          bulk__shard_batches=8, bulk__retries=3)
+    assert cfg.bulk.max_inflight == 7
+    assert auto_inflight(cfg) == 7
+    cfg = generate_config("tiny", "synthetic", fleet__replicas=2,
+                          serve__batch_size=4, serve__shed_watermark=32)
+    # auto: 2 batches x 2 replicas, under the watermark
+    assert auto_inflight(cfg) == 16
+    cfg = generate_config("tiny", "synthetic", fleet__replicas=8,
+                          serve__batch_size=8, serve__shed_watermark=16)
+    assert auto_inflight(cfg) == 15  # clamped under the lane watermark
