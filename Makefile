@@ -15,7 +15,7 @@ NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 
 .PHONY: all native lint test test-all test-gate serve-smoke ft-smoke \
 	obs-smoke perf-smoke elastic-smoke data-smoke fleet-smoke \
-	quant-smoke threadlint-smoke bulk-smoke clean
+	quant-smoke threadlint-smoke bulk-smoke crashsim-smoke clean
 
 all: native
 
@@ -29,11 +29,14 @@ $(NATIVE_LIB): $(NATIVE_SRC)
 # tests/test_recompile_guard.py); threadlint = lock-order / shared-state
 # / signal-handler hygiene (runtime half: the lock sanitizer, armed by
 # threadlint-smoke); configlint = cfg.<section>.<key> reads vs the
-# config.py dataclasses + dead-key detection
+# config.py dataclasses + dead-key detection; persistlint = the durable
+# write surface — tmp→fsync→rename→dir-fsync→manifest-last (runtime
+# half: the crashsim enumerator, crashsim-smoke)
 lint:
 	python -m mx_rcnn_tpu.analysis.graphlint mx_rcnn_tpu
 	python -m mx_rcnn_tpu.analysis.threadlint mx_rcnn_tpu
 	python -m mx_rcnn_tpu.analysis.configlint mx_rcnn_tpu
+	python -m mx_rcnn_tpu.analysis.persistlint mx_rcnn_tpu
 
 # quick tier: unit + fast integration — measured ~6 min idle / 12 min
 # contended on this 1-core box (r5: 211 tests)
@@ -138,6 +141,20 @@ bulk-smoke:
 	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.bulk \
 		--smoke --check
 
+# crash-consistency smoke (docs/ANALYSIS.md "crashsim"): records the
+# three persistence planes' REAL commit workloads (snapshotter epoch/
+# interrupt/GC commits, export-store create→add→finish, bulk-sink
+# manifest + shard commits) through the interposition shim, enumerates
+# EVERY crash state the persistence model allows (log truncation +
+# un-fsynced write drop/tear + un-dir-fsynced rename/unlink drop), and
+# runs the real recovery paths (latest_valid_checkpoint, ExportStore
+# load+admission, BulkSink resume cursor) against each — fails unless
+# every state recovers-or-refuses AND both planted removed-durability
+# arms (no-fsync snapshotter, no-dir-fsync export) are flagged.  ~1 min.
+crashsim-smoke:
+	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.crashsim \
+		--smoke --check --out /tmp/mxrcnn_crashsim_smoke.json
+
 # sanitized concurrency smoke (docs/ANALYSIS.md "threadlint"): re-runs
 # the serve and elastic smoke legs with the runtime lock sanitizer
 # armed in STRICT mode — every threading.Lock/RLock the serve/ft/data
@@ -175,8 +192,9 @@ elastic-smoke:
 # ~2 min), the quantized-inference smoke (quant-smoke, ~2 min), the
 # elastic shrink/grow storm (elastic-smoke, ~3 min) and the
 # sanitizer-armed serve+elastic re-run (threadlint-smoke, ~4 min)
-test-gate: lint serve-smoke perf-smoke obs-smoke data-smoke fleet-smoke \
-		bulk-smoke quant-smoke ft-smoke elastic-smoke threadlint-smoke
+test-gate: lint crashsim-smoke serve-smoke perf-smoke obs-smoke \
+		data-smoke fleet-smoke bulk-smoke quant-smoke ft-smoke \
+		elastic-smoke threadlint-smoke
 	python -m pytest tests/ -x -q -m "gate"
 
 clean:

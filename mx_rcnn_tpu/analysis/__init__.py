@@ -1,7 +1,7 @@
 """Static analysis + runtime sanitizers for the repo's machine-checked
 invariants (rule catalogues and waiver syntax: docs/ANALYSIS.md).
 
-Three linters share one Finding/waiver protocol (``common.py``), each
+Four linters share one Finding/waiver protocol (``common.py``), each
 paired with a runtime twin:
 
 * ``graphlint`` — TPU-graph hygiene: the hot path is ONE XLA program
@@ -19,8 +19,18 @@ paired with a runtime twin:
 * ``configlint`` — config-surface hygiene: every ``cfg.<section>.<key>``
   read must exist in the ``config.py`` dataclasses (CL101), and
   declared keys nobody reads are dead (CL201).
+* ``persistlint`` — durability hygiene over the durable-write surface:
+  every checkpoint / export-store / bulk-sink / manifest write must
+  ride the tmp→fsync→rename→dir-fsync, manifest-last idiom
+  (``utils/checkpoint._atomic_write``); flags raw durable writes,
+  un-fsynced renames, missing dir-fsyncs, manifest-before-payload
+  ordering, leaked staging files and unsorted sha-pinned dumps.
+  Runtime twin: ``crashsim.py`` — an interposition shim records the
+  real commit workloads' write ops, enumerates every crash state the
+  persistence model allows, and runs the REAL recovery paths against
+  each, asserting recover-or-refuse (``make crashsim-smoke``).
 
-All three run in ``make lint`` (first leg of ``make test-gate``):
+All four run in ``make lint`` (first leg of ``make test-gate``):
 ``python -m mx_rcnn_tpu.analysis.<tool> mx_rcnn_tpu``.
 
 Import ``RULES`` / ``lint_paths`` from the tool modules directly (kept

@@ -211,6 +211,7 @@ class DecodedImageCache:
                 # ".npy" to the tmp name and break the atomic rename
                 with open(tmp, "wb") as f:
                     np.save(f, img)
+                # persistlint: disable=PL102,PL103 TRIAGED (ISSUE 12): the cache is rebuildable, not durable state — a crash-torn or lost .npy fails np.load (or the size/magic check) and falls through to a fresh decode that overwrites it (pinned by test_data.py::test_torn_disk_cache_falls_through_to_decode); an fsync per image would put disk-flush latency on the prefetch pool's ~ms hot path for zero correctness gain
                 os.replace(tmp, fp)
                 # evict superseded versions of this entry (same stable
                 # prefix, different mtime/size version) so regenerating the

@@ -4,19 +4,30 @@ No reference equivalent — the reference's only run artifact is the stdout
 log.  Every ``tools/train.py`` / ``tools/serve.py`` invocation with
 ``obs.enabled`` writes:
 
-* ``runs/<id>/events.jsonl`` — one JSON object per line, appended live
-  (crash-safe: each line is flushed), schema::
+* ``runs/<id>/events.jsonl`` — one JSON object per line, appended live,
+  schema::
 
       {"ts": <unix seconds>, "event": "<kind>", ...payload}
 
-  Event kinds emitted by the wired CLIs: ``run_start``, ``epoch_start``,
-  ``log`` (one per Speedometer window: averaged metrics + throughput),
-  ``epoch_end``, ``snapshot``, ``interrupt``, ``run_finish``.
+  Crash contract (LINE-GRANULAR, verified by the crashsim regression in
+  ``tests/test_persistlint.py``): each line is flushed to the kernel as
+  it is written (line buffering), so a PROCESS crash loses nothing; a
+  HOST crash may lose an un-fsynced tail and may tear the last line at
+  a byte boundary — readers must treat ``events.jsonl`` as "every fully
+  parseable line is real, a torn tail line is the crash point", never
+  as an atomic document.  ``close()`` fsyncs the stream so a finished
+  run's final flush is durable.  Per-event fsync (or an atomic rewrite
+  per event) would serialize the training/serving hot path behind disk
+  latency, which the line-granular contract exists to avoid.
 * ``runs/<id>/summary.json`` — ONE final BENCH-compatible record
   (``{"metric": ..., "value": ..., "measured": ...}`` like ``bench.py``
   and ``tools/loadgen.py`` emit) plus the closing snapshot of the
   process metrics registry, so a finished run is analyzable without
-  re-parsing the event stream.
+  re-parsing the event stream.  Unlike the event stream this IS an
+  atomic document (one shot, read as a whole), so it goes through
+  ``utils/checkpoint._atomic_write`` — a crash during the final write
+  leaves the previous state, never a torn half-summary that parses as
+  a finished run.
 * ``runs/<id>/trace.json`` / ``runs/<id>/profile/`` — chrome trace and
   profiler windows, when those subsystems are enabled (written by the
   CLIs, not by this class).
@@ -77,6 +88,7 @@ class RunRecord:
         self._dead = False
         try:
             os.makedirs(self.dir, exist_ok=True)
+            # persistlint: disable=PL101 append-only event stream with a LINE-GRANULAR crash contract (module docstring): each line is kernel-flushed, readers tolerate a torn tail line, close() fsyncs; an atomic rewrite per event would put disk latency on the hot path
             self._f = open(self.events_path, "a", buffering=1)
         except OSError as e:
             logger.warning("obs runrec: cannot open %s (%s) — run record "
@@ -135,8 +147,11 @@ class RunRecord:
             "metrics": registry.snapshot(),
         }
         try:
-            with open(self.summary_path, "w") as f:
-                json.dump(summary, f, indent=1, default=_jsonable)
+            from mx_rcnn_tpu.utils.checkpoint import _atomic_write
+
+            _atomic_write(self.summary_path,
+                          json.dumps(summary, indent=1,
+                                     default=_jsonable).encode())
         except OSError as e:
             logger.warning("obs runrec: summary write failed: %s", e)
         return summary
@@ -144,6 +159,16 @@ class RunRecord:
     def close(self) -> None:
         with self._lock:
             if self._f is not None:
+                try:
+                    # the final flush is durable: a run that reported
+                    # success must not lose its closing events to a host
+                    # crash (the line-granular contract's one fsync)
+                    self._f.flush()
+                    os.fsync(self._f.fileno())
+                except (OSError, ValueError) as e:
+                    logger.warning("obs runrec: final event-stream "
+                                   "fsync failed (%s) — a host crash "
+                                   "may lose the closing events", e)
                 try:
                     self._f.close()
                 except OSError:

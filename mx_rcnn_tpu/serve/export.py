@@ -155,13 +155,15 @@ class ExportStore:
         be the static args the live call site passes)."""
         from jax import export as jexport
 
+        from mx_rcnn_tpu.utils.checkpoint import _atomic_write
+
         exp = jexport.export(fn)(*_spec_of(args), **(static_kwargs or {}))
         blob = exp.serialize()
         path = os.path.join(self.root, f"{name}.jaxexp")
-        with open(path, "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
+        # the shared durable-write primitive (tmp -> fsync -> rename ->
+        # dir-fsync): a crash mid-export can never leave a torn .jaxexp
+        # under the committed name (tests/test_fleet.py pins the order)
+        _atomic_write(path, blob)
         self._manifest["entries"][name] = {
             "file": f"{name}.jaxexp",
             "bytes": len(blob),
@@ -172,14 +174,17 @@ class ExportStore:
 
     def finish(self) -> str:
         """Commit the manifest (written LAST: its presence means every
-        program file it names is fully on disk)."""
+        program file it names is fully on disk).  Shares
+        ``utils/checkpoint._atomic_write`` with every other commit point
+        in the tree — the hand-rolled tmp→fsync→replace this method used
+        to carry skipped the directory fsync, so a host crash could lose
+        the 'committed' manifest (persistlint PL103; the crashsim
+        ``export_nodirfsync`` arm reproduces the lost commit)."""
+        from mx_rcnn_tpu.utils.checkpoint import _atomic_write
+
         path = os.path.join(self.root, MANIFEST_NAME)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self._manifest, f, indent=1, sort_keys=True)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        _atomic_write(path, json.dumps(self._manifest, indent=1,
+                                       sort_keys=True).encode())
         return path
 
     # ------------------------------------------------------------------
@@ -275,8 +280,18 @@ class ExportStore:
 
         entry = self.manifest()["entries"][name]
         path = os.path.join(self.root, entry["file"])
-        with open(path, "rb") as f:
-            blob = f.read()
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except FileNotFoundError:
+            # a manifest naming a missing program means the store lost
+            # files after its commit point — refuse through the
+            # documented surface, not a raw ENOENT (crashsim found this:
+            # the recovery path's refusals must be typed)
+            raise ExportMismatch(
+                f"export store {self.root} is missing {entry['file']} "
+                f"although the manifest names it — the store is "
+                "corrupt; re-export") from None
         sha = hashlib.sha256(blob).hexdigest()
         if sha != entry["sha256"]:
             raise ExportMismatch(

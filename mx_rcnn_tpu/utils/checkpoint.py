@@ -26,6 +26,7 @@ import dataclasses
 import hashlib
 import json
 import os
+import threading
 from typing import Any, Dict, Iterable, Optional, Tuple
 
 import jax
@@ -42,18 +43,34 @@ def _atomic_write(path: str, data: bytes) -> str:
     """Durable atomic rename write: tmp → fsync(tmp) → replace →
     fsync(dir).  A crash mid-write can't corrupt an existing file, and a
     HOST crash after the replace can't lose the rename (the directory
-    entry itself is synced).  Single implementation shared by the epoch
-    and interrupt checkpoints and their manifests so the write discipline
-    cannot diverge (tests/test_checkpoint.py pins the call order)."""
+    entry itself is synced).  THE single implementation for every
+    durable artifact in the tree — checkpoints, manifests, export-store
+    programs, bulk-sink shards, run summaries — so the write discipline
+    cannot diverge (tests/test_checkpoint.py pins the syscall order;
+    ``analysis/persistlint.py`` PL101 flags raw writes that bypass it,
+    and ``analysis/crashsim.py`` enumerates the crash states of runs
+    that use it).  The staging name is pid/thread-unique (so two
+    writers racing the same target can never truncate or unlink each
+    other's in-flight bytes — last rename wins whole) while keeping the
+    ``.tmp`` SUFFIX the orphan sweeps match on, and a failed write
+    unlinks its own staging file so exception paths never leak
+    adoptable orphans (PL105)."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    tmp = f"{path}.{os.getpid()}-{threading.get_ident()}.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     dir_fd = os.open(d or ".", os.O_RDONLY)
     try:
         os.fsync(dir_fd)
@@ -180,8 +197,11 @@ def write_manifest(path: str, data: bytes, *, kind: str, step: int,
                 "images_consumed": int(step)
                 * int(topology.get("global_batch", 0)),
             }
+    # sort_keys: the manifest is the admission/commit record — its bytes
+    # must not depend on dict insertion order (persistlint PL201)
     return _atomic_write(manifest_path(path),
-                         json.dumps(manifest, indent=1).encode())
+                         json.dumps(manifest, indent=1,
+                                    sort_keys=True).encode())
 
 
 def read_manifest(path: str) -> Optional[Dict[str, Any]]:

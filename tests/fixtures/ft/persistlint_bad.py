@@ -1,0 +1,99 @@
+"""persistlint fixture: every PL rule trips at least once here
+(tests/test_persistlint.py pins the full set — the linter cannot
+silently lose a rule).  Each function is a minimal bad example of one
+rule; the docstrings say what SHOULD have been written."""
+
+import hashlib
+import json
+import os
+
+
+def pl101_raw_durable_write(data: bytes) -> None:
+    """Bad: a durable checkpoint artifact written with a bare open —
+    a crash mid-write leaves a torn .ckpt under the committed name.
+    Good: utils/checkpoint._atomic_write(path, data)."""
+    path = "out/model-0001.ckpt"
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def pl102_rename_source_not_fsynced(data: bytes) -> None:
+    """Bad: the staging file is renamed without ever being fsynced —
+    the rename can persist while the data does not."""
+    tmp = "out/model-0002.ckpt.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, "out/model-0002.ckpt")
+        dfd = os.open("out", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        os.unlink(tmp)
+        raise
+
+
+def pl103_rename_without_dirfsync(data: bytes) -> None:
+    """Bad: data fsynced, but no directory fsync after the rename — a
+    host crash can lose the rename, so the 'committed' file vanishes."""
+    tmp = "out/model-0003.ckpt.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, "out/model-0003.ckpt")
+    except OSError:
+        os.unlink(tmp)
+        raise
+
+
+def pl104_manifest_before_payload(payload: bytes) -> None:
+    """Bad: the commit-point manifest is written BEFORE the payload it
+    names — a crash between the two commits a manifest for files that
+    do not exist yet.  (Both writes also trip PL101: raw opens.)"""
+    with open("out/snap.manifest.json", "w") as f:
+        f.write('{"files": {"snap.ckpt": {}}}')
+    with open("out/snap.ckpt", "wb") as f:
+        f.write(payload)
+
+
+def pl105_tmp_leaked_on_exception(data: bytes) -> None:
+    """Bad: no try/except cleanup around the staging write — a failed
+    write leaks an adoptable .tmp orphan."""
+    tmp = "out/model-0005.ckpt.tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, "out/model-0005.ckpt")
+    dfd = os.open("out", os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
+def pl201_unsorted_fingerprint(recipe: dict) -> str:
+    """Bad: a sha-pinned identity serialized without sort_keys — the
+    fingerprint depends on dict insertion order."""
+    return hashlib.sha256(json.dumps(recipe).encode()).hexdigest()
+
+
+def pl001_reasonless_waiver(data: bytes) -> None:
+    """A waiver with no reason silences its finding but is itself a
+    finding (PL001) — and naming a rule that does not exist is PL002."""
+    tmp = "out/model-0006.ckpt.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        # persistlint: disable=PL103
+        os.replace(tmp, "out/model-0006.ckpt")
+    except OSError:
+        os.unlink(tmp)
+        raise
+    # persistlint: disable=PL999 no such rule

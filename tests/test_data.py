@@ -318,6 +318,38 @@ def test_plan_scale_matches_decode_over_random_geometries(tmp_path):
         assert img.shape[0] <= bucket[0] and img.shape[1] <= bucket[1]
 
 
+def test_torn_disk_cache_falls_through_to_decode(tmp_path):
+    """The ISSUE-12 triage decision behind cache.py's PL102/PL103
+    waiver: the decoded-image cache commits via os.replace WITHOUT an
+    fsync because it is rebuildable, not durable state — a crash-torn
+    (truncated) or zero-length .npy must fail np.load's own validation,
+    fall through to a fresh decode, and be overwritten with a good
+    entry.  If this stops holding, the waiver (and the fsync-free
+    commit) must go."""
+    from PIL import Image
+
+    from mx_rcnn_tpu.data.cache import DecodedImageCache
+
+    p = tmp_path / "img.png"
+    Image.fromarray(np.full((40, 60, 3), 77, np.uint8)).save(p)
+    cache = DecodedImageCache(ram_bytes=0, cache_dir=str(tmp_path / "c"))
+    good = cache.load(str(p), False, 32, 64, (32, 64))
+    assert cache.misses == 1
+    import glob as _glob
+    (entry,) = _glob.glob(str(tmp_path / "c" / "*.npy"))
+    full = open(entry, "rb").read()
+    for torn in (full[: len(full) // 2], b""):
+        with open(entry, "wb") as f:   # simulate the crash state
+            f.write(torn)
+        fresh = DecodedImageCache(ram_bytes=0,
+                                  cache_dir=str(tmp_path / "c"))
+        got = fresh.load(str(p), False, 32, 64, (32, 64))
+        np.testing.assert_array_equal(got, good)
+        assert fresh.misses == 1, "torn entry must MISS, not serve"
+        # and the re-decode repaired the on-disk entry
+        assert open(entry, "rb").read() == full
+
+
 def test_cache_invalidates_on_source_file_change(tmp_path):
     """Replacing a source image must invalidate its disk-cache entry
     (advisor r3: the key previously hashed only path + geometry)."""

@@ -1,0 +1,555 @@
+"""persistlint + crashsim contract tests (ISSUE 12 tentpole), mirroring
+``tests/test_graphlint.py`` / ``tests/test_threadlint.py``:
+
+* the SHIPPED tree is clean — zero unwaived persistlint findings over
+  ``mx_rcnn_tpu``, every waiver reasoned;
+* the fixture (``tests/fixtures/ft/persistlint_bad.py``) trips EVERY PL
+  rule — the linter cannot silently lose a rule;
+* behavioral tests per rule (durable-path inference through naming
+  helpers, the staging-write exemption, rename/fsync ordering, the
+  manifest-last rule, tmp cleanup, sort_keys pinning, waivers);
+* the crashsim runtime twin: op-log capture of the real atomic-write
+  idiom, fsync/dir-fsync barrier semantics under enumeration (forced
+  vs in-flight vs torn), recover-or-refuse verdicts on the real
+  snapshotter/bulk recovery paths, the runrec summary/events crash
+  contract, and PLANTED-violation sensitivity (a removed-fsync arm
+  must be flagged — zero-sensitivity is a failure);
+* the export store commits through the shared ``_atomic_write`` with
+  the pinned syscall order (the satellite-1 regression mirroring
+  ``test_checkpoint.py — test_atomic_write_discipline``).
+"""
+
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.analysis import persistlint
+from mx_rcnn_tpu.analysis.crashsim import (CrashRecorder, crash_states,
+                                           simulate)
+from mx_rcnn_tpu.analysis.persistlint import RULES, lint_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "mx_rcnn_tpu")
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "ft",
+                       "persistlint_bad.py")
+
+
+# ---------------------------------------------------------------------------
+# static pass: the shipped tree + the fixture
+# ---------------------------------------------------------------------------
+
+def test_shipped_tree_has_zero_unwaived_findings():
+    findings = lint_paths([PKG])
+    active = [f for f in findings if f.waived is None]
+    assert active == [], "\n".join(f.render() for f in active)
+    for f in findings:
+        if f.waived is not None:
+            assert f.waived.strip(), f.render()
+
+
+def test_cli_exit_codes(capsys):
+    assert persistlint.main([PKG]) == 0
+    assert persistlint.main([FIXTURE]) == 1
+    assert persistlint.main(["--list-rules"]) == 0
+    assert persistlint.main([os.path.join(REPO, "no_such_dir")]) == 2
+    capsys.readouterr()
+
+
+def test_fixture_trips_every_rule():
+    findings = lint_paths([FIXTURE])
+    codes = {f.code for f in findings}
+    assert codes == set(RULES), (
+        f"missing: {set(RULES) - codes}, unexpected: {codes - set(RULES)}")
+    # the reasonless PL103 waiver silences its finding but raises PL001
+    assert any(f.code == "PL103" and f.waived is not None
+               for f in findings)
+    assert any(f.code == "PL001" for f in findings)
+
+
+def _lint_snippet(tmp_path, source, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return lint_paths([str(p)])
+
+
+def test_durable_path_inference_through_naming_helper(tmp_path):
+    """The call-graph closure: an open() of helper(x) is durable when
+    the HELPER's return expression carries a durable fragment."""
+    findings = _lint_snippet(tmp_path, """\
+        def ckpt_path(prefix, epoch):
+            return f"{prefix}-{epoch:04d}.ckpt"
+
+        def save(prefix, epoch, data):
+            with open(ckpt_path(prefix, epoch), "wb") as f:
+                f.write(data)
+        """)
+    assert [f.code for f in findings] == ["PL101"]
+
+
+def test_ephemeral_writes_are_not_flagged(tmp_path):
+    """Bench reports / rerunnable artifacts sit OUTSIDE the durable
+    surface — the triage line the docs argue."""
+    findings = _lint_snippet(tmp_path, """\
+        import json
+
+        def write_report(out, record):
+            with open(out, "w") as f:
+                json.dump(record, f, indent=1)
+
+        def write_eval_dump(path, blob):
+            with open("results/dets.pkl", "wb") as f:
+                f.write(blob)
+        """)
+    assert findings == []
+
+
+def test_staging_write_is_exempt_but_ordering_rules_fire(tmp_path):
+    """An open() whose path is later an os.replace SOURCE is the staging
+    write of the atomic idiom — no PL101; PL102/PL103 govern it."""
+    findings = _lint_snippet(tmp_path, """\
+        import os
+
+        def commit(path, data):
+            tmp = path + ".manifest.json.tmp"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path + ".manifest.json")
+            except OSError:
+                os.unlink(tmp)
+                raise
+        """)
+    assert sorted(f.code for f in findings) == ["PL102", "PL103"]
+
+
+def test_full_atomic_idiom_is_clean(tmp_path):
+    findings = _lint_snippet(tmp_path, """\
+        import os
+
+        def _atomic_write(path, data):
+            tmp = path + ".tmp"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        """)
+    assert findings == []
+
+
+def test_atomic_channel_calls_are_not_raw_writes(tmp_path):
+    """A function routing a durable path through (a transitive caller
+    of) _atomic_write is clean, and manifest-last ordering via the
+    closure is enforced (PL104)."""
+    good = _lint_snippet(tmp_path, """\
+        def _atomic_write(path, data):
+            pass
+
+        def write_manifest(path, data):
+            _atomic_write(path + ".manifest.json", data)
+
+        def commit(path, data):
+            _atomic_write(path, data)
+            write_manifest(path, b"{}")
+        """)
+    assert good == []
+    bad = _lint_snippet(tmp_path, """\
+        def _atomic_write(path, data):
+            pass
+
+        def write_manifest(path, data):
+            _atomic_write(path + ".manifest.json", data)
+
+        def commit(path, data):
+            write_manifest(path, b"{}")
+            _atomic_write(path, data)
+        """, name="bad.py")
+    assert [f.code for f in bad] == ["PL104"]
+
+
+def test_pl102_one_fsync_does_not_vouch_for_a_second_staged_file(
+        tmp_path):
+    """An fsync bound to staged file A must not clear the rename of
+    staged file B (fsync is per-file; code-review regression)."""
+    findings = _lint_snippet(tmp_path, """\
+        import os
+
+        def commit_two(data):
+            tmp1 = "out/a.ckpt.tmp"
+            tmp2 = "out/b.ckpt.tmp"
+            try:
+                with open(tmp1, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                with open(tmp2, "wb") as g:
+                    g.write(data)
+                os.replace(tmp1, "out/a.ckpt")
+                os.replace(tmp2, "out/b.ckpt")
+                dfd = os.open("out", os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except OSError:
+                os.unlink(tmp1)
+                os.unlink(tmp2)
+                raise
+        """)
+    assert [f.code for f in findings] == ["PL102"], findings
+    # and it anchors at tmp2's rename (line 14), not tmp1's (line 13)
+    assert findings[0].line == 14, findings[0].render()
+
+
+def test_pl201_sorted_dump_clean_unsorted_flagged(tmp_path):
+    bad = _lint_snippet(tmp_path, """\
+        import hashlib
+        import json
+
+        def fingerprint(ident):
+            return hashlib.sha256(json.dumps(ident).encode()).hexdigest()
+        """)
+    assert [f.code for f in bad] == ["PL201"]
+    good = _lint_snippet(tmp_path, """\
+        import hashlib
+        import json
+
+        def fingerprint(ident):
+            return hashlib.sha256(
+                json.dumps(ident, sort_keys=True).encode()).hexdigest()
+        """, name="good.py")
+    assert good == []
+
+
+def test_waiver_with_reason_silences(tmp_path):
+    findings = _lint_snippet(tmp_path, """\
+        def append_events(path):
+            # persistlint: disable=PL101 line-granular stream, readers tolerate a torn tail
+            f = open("runs/x/events.jsonl", "a")
+            f.write("{}")
+        """)
+    active = [f for f in findings if f.waived is None]
+    assert active == []
+    assert any(f.code == "PL101" and f.waived for f in findings)
+
+
+def test_list_rules_names_every_code(capsys):
+    persistlint.main(["--list-rules"])
+    out = capsys.readouterr().out
+    for code in RULES:
+        assert code in out
+
+
+# ---------------------------------------------------------------------------
+# crashsim: op-log capture
+# ---------------------------------------------------------------------------
+
+def test_recorder_captures_atomic_write_op_sequence(tmp_path):
+    from mx_rcnn_tpu.utils.checkpoint import _atomic_write
+
+    root = str(tmp_path / "w")
+    os.makedirs(root)
+    with CrashRecorder(root) as rec:
+        _atomic_write(os.path.join(root, "a.ckpt"), b"payload")
+        rec.mark_commit("a")
+    kinds = [op.kind for op in rec.ops]
+    assert kinds == ["write", "fsync", "rename", "dirfsync", "commit"]
+    assert rec.ops[0].data == b"payload"
+    assert rec.ops[2].dst.endswith("a.ckpt")
+    # arming is fully reversible
+    import builtins
+    assert builtins.open is not None and not rec._armed
+
+
+def test_recorder_ignores_out_of_root_and_drop_modes(tmp_path):
+    from mx_rcnn_tpu.utils.checkpoint import _atomic_write
+
+    root = str(tmp_path / "w")
+    os.makedirs(root)
+    outside = str(tmp_path / "elsewhere.ckpt")
+    with CrashRecorder(root, drop=("fsync", "dirfsync")) as rec:
+        _atomic_write(outside, b"x")                      # not under root
+        _atomic_write(os.path.join(root, "a.ckpt"), b"y")
+    kinds = [op.kind for op in rec.ops]
+    assert "fsync" not in kinds and "dirfsync" not in kinds
+    assert kinds == ["write", "rename"]
+    assert all(op.path.startswith(root) for op in rec.ops)
+    # the real syscalls still ran: both files exist complete
+    assert open(outside, "rb").read() == b"x"
+
+
+# ---------------------------------------------------------------------------
+# crashsim: barrier / reordering semantics of the enumerator
+# ---------------------------------------------------------------------------
+
+def _states(ops, root):
+    return [st for st in crash_states(ops, root)
+            if st.decisions != ("CAPPED",)]
+
+
+def test_unfsynced_write_can_drop_or_tear_fsynced_is_forced(tmp_path):
+    from mx_rcnn_tpu.analysis.crashsim import Op
+
+    root = str(tmp_path)
+    f1 = os.path.join(root, "f1")
+    ops = [Op("write", path=f1, data=b"AAAABBBB"),
+           Op("fsync", path=f1)]
+    # crash right after the write, before the barrier: absent, torn and
+    # full variants all reachable
+    pre = [st.fs.get("f1") for st in _states(ops[:1], root)]
+    assert None in pre and b"AAAABBBB" in pre and b"AAAA" in pre
+    # after the fsync: forced — exactly one full state at that point
+    post = [st.fs.get("f1") for st in _states(ops, root)
+            if st.point == 2]
+    assert post == [b"AAAABBBB"]
+
+
+def test_rename_before_dirfsync_droppable_after_forced(tmp_path):
+    from mx_rcnn_tpu.analysis.crashsim import Op
+
+    root = str(tmp_path)
+    tmp, dst = os.path.join(root, "x.tmp"), os.path.join(root, "x")
+    ops = [Op("write", path=tmp, data=b"D" * 8),
+           Op("fsync", path=tmp),
+           Op("rename", path=tmp, dst=dst),
+           Op("dirfsync", path=root)]
+    # between rename and dirfsync the rename is in flight: states both
+    # with and without the published name
+    mid = [st.fs for st in _states(ops[:3], root) if st.point == 3]
+    assert any("x" in fs for fs in mid) and any("x" not in fs
+                                                for fs in mid)
+    # after the dirfsync the publish is forced everywhere
+    post = [st.fs for st in _states(ops, root) if st.point == 4]
+    assert post and all(fs.get("x") == b"D" * 8 for fs in post)
+
+
+def test_rename_persisting_without_unfsynced_data_is_torn_publish(
+        tmp_path):
+    """The classic ALICE state: the dir entry makes it, the data does
+    not — reachable exactly when the source was never fsynced."""
+    from mx_rcnn_tpu.analysis.crashsim import Op
+
+    root = str(tmp_path)
+    tmp, dst = os.path.join(root, "x.tmp"), os.path.join(root, "x")
+    ops = [Op("write", path=tmp, data=b"D" * 8),
+           Op("rename", path=tmp, dst=dst)]
+    fss = [st.fs for st in _states(ops, root) if st.point == 2]
+    assert any(fs.get("x") == b"" for fs in fss), \
+        "torn publish (rename without data) must be enumerated"
+
+
+def test_verdict_engine_flags_refusal_after_commit(tmp_path):
+    """simulate(): refusing while a durable floor exists is a
+    violation; refusing before any commit is legal."""
+    from mx_rcnn_tpu.analysis.crashsim import Op
+
+    root = str(tmp_path)
+    f1 = os.path.join(root, "art")
+    # no barriers at all, then a commit marker: the classic planted bug
+    ops = [Op("write", path=f1, data=b"A" * 8),
+           Op("commit", ident="a")]
+    scratch = str(tmp_path / "_s")
+
+    def recover(d):
+        p = os.path.join(d, "art")
+        if os.path.exists(p) and open(p, "rb").read() == b"A" * 8:
+            return ("recovered", "a")
+        return ("refused", "artifact missing or torn")
+
+    rep = simulate(ops, root, recover, ["a"], scratch)
+    assert not rep["ok"]
+    assert any("durably committed" in v["problem"]
+               for v in rep["violations"])
+
+    # an UNTYPED crash in the recovery path is a recorded violation,
+    # never an aborted enumeration (code-review regression)
+    def crashy_recover(d):
+        raise AttributeError("manifest shape surprised the loader")
+
+    rep_crash = simulate(ops, root, crashy_recover, ["a"], scratch)
+    assert not rep_crash["ok"]
+    assert any("UNTYPED exception" in v["problem"]
+               for v in rep_crash["violations"])
+    # with the barrier the same workload is clean
+    ops_ok = [Op("write", path=f1, data=b"A" * 8),
+              Op("fsync", path=f1), Op("commit", ident="a")]
+    rep_ok = simulate(ops_ok, root, recover, ["a"], scratch)
+    assert rep_ok["ok"] and rep_ok["states_total"] > 0
+
+
+# ---------------------------------------------------------------------------
+# crashsim: the real recovery paths (tool workloads, gate-speed slices)
+# ---------------------------------------------------------------------------
+
+def test_snapshotter_workload_recovers_or_refuses_every_state(tmp_path):
+    from mx_rcnn_tpu.tools.crashsim import run_snapshotter
+
+    rep = run_snapshotter(str(tmp_path / "w"), max_states=64)
+    assert rep["ok"], rep["violations"][:3]
+    assert rep["states_total"] > 50
+    assert rep["recovered"] > 0 and rep["refused"] > 0
+
+
+def test_bulk_workload_recovers_or_refuses_every_state(tmp_path):
+    from mx_rcnn_tpu.tools.crashsim import run_bulk
+
+    rep = run_bulk(str(tmp_path / "w"), max_states=64)
+    assert rep["ok"], rep["violations"][:3]
+    assert rep["states_total"] > 20
+
+
+def test_planted_removed_fsync_arm_is_flagged(tmp_path):
+    """Sensitivity: the snapshotter workload with its fsync barriers
+    removed from the log MUST produce recover-or-refuse violations —
+    a crashsim that passes this arm is a rubber stamp."""
+    from mx_rcnn_tpu.tools.crashsim import run_snapshotter
+
+    rep = run_snapshotter(str(tmp_path / "w"),
+                          drop=("fsync", "dirfsync"), max_states=16)
+    assert not rep["ok"]
+    assert any("durably committed" in v["problem"]
+               for v in rep["violations"])
+
+
+def test_export_store_missing_dirfsync_arm_reproduces_old_bug(tmp_path):
+    """The pre-ISSUE-12 ``ExportStore.finish`` skipped the dir-fsync;
+    the dirfsync-dropped arm reproduces the lost-commit state crashsim
+    exists to catch (and the fixed code's real arm is clean — covered
+    by crashsim-smoke, which runs the full export workload)."""
+    from mx_rcnn_tpu.tools.crashsim import run_export
+
+    rep = run_export(str(tmp_path / "w"), drop=("dirfsync",),
+                     max_states=32)
+    assert not rep["ok"]
+    assert any(v["floor"] == "store" for v in rep["violations"])
+
+
+# ---------------------------------------------------------------------------
+# satellite regressions
+# ---------------------------------------------------------------------------
+
+def test_export_store_commit_syscall_order(tmp_path, monkeypatch):
+    """Satellite 1: ExportStore.add/finish route through the SHARED
+    ``_atomic_write`` — fsync(file) → replace → fsync(dir) for the
+    program AND the manifest (mirrors test_checkpoint.py's
+    test_atomic_write_discipline; the manifest commit previously
+    skipped the dir fsync)."""
+    import jax
+
+    from mx_rcnn_tpu.config import generate_config
+    from mx_rcnn_tpu.serve.export import ExportStore
+
+    cfg = generate_config("tiny", "synthetic")
+    events = []
+    real_fsync, real_replace, real_open = os.fsync, os.replace, os.open
+    real_close = os.close
+    fd_kind = {}
+
+    def spy_open(path, flags, *a, **kw):
+        fd = real_open(path, flags, *a, **kw)
+        if isinstance(path, (str, os.PathLike)):
+            fd_kind[fd] = "dir" if os.path.isdir(path) else "file"
+        return fd
+
+    def spy_close(fd):
+        # fd numbers are recycled: a closed dir fd must not mislabel
+        # the next regular file that lands on the same number
+        fd_kind.pop(fd, None)
+        return real_close(fd)
+
+    def spy_fsync(fd):
+        events.append(("fsync", fd_kind.get(fd, "file")))
+        return real_fsync(fd)
+
+    def spy_replace(src, dst):
+        events.append(("replace", os.path.basename(dst)))
+        return real_replace(src, dst)
+
+    fn = jax.jit(lambda v: v * 2.0)
+    x = np.arange(4, dtype=np.float32)
+    store = ExportStore.create(str(tmp_path / "store"), cfg)
+    monkeypatch.setattr(os, "open", spy_open)
+    monkeypatch.setattr(os, "close", spy_close)
+    monkeypatch.setattr(os, "fsync", spy_fsync)
+    monkeypatch.setattr(os, "replace", spy_replace)
+    store.add("double", fn, (x,))
+    store.finish()
+    monkeypatch.undo()
+    assert events == [
+        ("fsync", "file"), ("replace", "double.jaxexp"), ("fsync", "dir"),
+        ("fsync", "file"), ("replace", "manifest.json"), ("fsync", "dir"),
+    ], events
+    # and the committed store still loads + admits
+    store2 = ExportStore(str(tmp_path / "store"))
+    store2.check(cfg)
+    got = np.asarray(store2.load("double")(x))
+    np.testing.assert_array_equal(got, x * 2.0)
+
+
+def test_runrec_summary_atomic_and_events_line_granular(tmp_path):
+    """Satellite 3 via crashsim: across EVERY crash state of a runrec
+    session, summary.json is all-or-nothing (atomic write) and
+    events.jsonl honors the line-granular contract — every complete
+    line parses and is a prefix of the true event stream; only the
+    tail line may tear."""
+    from mx_rcnn_tpu.obs.runrec import RunRecord
+
+    root = str(tmp_path / "w")
+    os.makedirs(root)
+    with CrashRecorder(root) as rec:
+        r = RunRecord("crashsimtest", base_dir=os.path.join(root, "runs"),
+                      run_id="r1")
+        for i in range(5):
+            r.event("tick", i=i)
+        r.finish(metric="ticks", value=5, registry=_EmptyRegistry())
+        r.close()
+        rec.mark_commit("final")
+    run_dir = os.path.join("runs", "r1")
+    true_lines = None
+    problems = []
+
+    def recover(d):
+        nonlocal true_lines
+        sp = os.path.join(d, run_dir, "summary.json")
+        ep = os.path.join(d, run_dir, "events.jsonl")
+        if os.path.exists(ep):
+            raw = open(ep, "rb").read().decode("utf-8", "replace")
+            complete = raw.split("\n")[:-1]
+            for ln in complete:
+                try:
+                    json.loads(ln)
+                except ValueError:
+                    return ("corrupt", f"complete event line torn: {ln!r}")
+        if not os.path.exists(sp):
+            return ("refused", "no summary yet")
+        try:
+            summary = json.load(open(sp))
+        except ValueError:
+            return ("corrupt", "summary.json torn — atomicity violated")
+        assert summary["value"] == 5
+        return ("recovered", "final")
+
+    rep = simulate(rec.ops, root, recover, ["final"],
+                   str(tmp_path / "_s"))
+    assert rep["ok"], rep["violations"][:3]
+    assert rep["states_total"] > 5
+
+
+class _EmptyRegistry:
+    def snapshot(self):
+        return {}
