@@ -15,7 +15,8 @@ NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 
 .PHONY: all native lint test test-all test-gate serve-smoke ft-smoke \
 	obs-smoke perf-smoke elastic-smoke data-smoke fleet-smoke \
-	quant-smoke threadlint-smoke bulk-smoke crashsim-smoke clean
+	quant-smoke threadlint-smoke bulk-smoke crashsim-smoke \
+	health-smoke clean
 
 all: native
 
@@ -68,6 +69,17 @@ serve-smoke:
 # ~1 min warm (shares the XLA compile cache with the test suite).
 obs-smoke:
 	python -m mx_rcnn_tpu.tools.obs_smoke --check
+
+# fleet-health smoke (docs/OBSERVABILITY.md "Time-series plane"): an
+# obs-instrumented 2-replica stub fleet under a closed-loop burst with
+# one replica killed mid-burst — fails unless the collector's merged
+# view shows both replicas + the elastic HTTP source with source/
+# generation labels, the SLO verdict transitions OK -> CRITICAL on the
+# eject and back to OK after the relaunch, a parseable flight record
+# names the ejected replica, and `tools/obs.py check` over the healed
+# live fleet exits 0.  ~30 s.
+health-smoke:
+	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.obs smoke --check
 
 # perf-tooling smoke (docs/PERF.md "Round-6"): CPU-backend sanity run of
 # the stage profiler on the tiny model (N=2 unrolled chains) — fails
@@ -186,15 +198,16 @@ elastic-smoke:
 # the linters run first: a hygiene violation fails the gate in seconds
 # instead of after 30 minutes of training; serve-smoke next (~30 s),
 # then the perf-tooling smoke (~1 min), the observability smoke
-# (~1 min), the streaming input-plane smoke (data-smoke, ~30 s), the
+# (~1 min), the fleet-health smoke (health-smoke, ~30 s), the
+# streaming input-plane smoke (data-smoke, ~30 s), the
 # serving-fleet smoke (fleet-smoke, ~2 min), the bulk kill+resume
 # smoke (bulk-smoke, ~2 min), the 2-kill crash loop (ft-smoke,
 # ~2 min), the quantized-inference smoke (quant-smoke, ~2 min), the
 # elastic shrink/grow storm (elastic-smoke, ~3 min) and the
 # sanitizer-armed serve+elastic re-run (threadlint-smoke, ~4 min)
 test-gate: lint crashsim-smoke serve-smoke perf-smoke obs-smoke \
-		data-smoke fleet-smoke bulk-smoke quant-smoke ft-smoke \
-		elastic-smoke threadlint-smoke
+		health-smoke data-smoke fleet-smoke bulk-smoke quant-smoke \
+		ft-smoke elastic-smoke threadlint-smoke
 	python -m pytest tests/ -x -q -m "gate"
 
 clean:

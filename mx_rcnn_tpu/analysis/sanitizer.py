@@ -94,6 +94,37 @@ class _State:
 
 _S = _State()
 
+# watchdog-trip listeners: the flight recorder (obs/flightrec.py)
+# registers here so a stalled lock dumps a black-box record alongside
+# the stack dump.  Raw-locked (sanitizer-internal state), invoked
+# OUTSIDE _S.lock and fail-soft — a listener exception must not kill
+# the watchdog thread.
+_trip_listeners_lock = _RAW_LOCK()
+_trip_listeners: List = []
+
+
+def add_trip_listener(fn) -> None:
+    """Register ``fn(trip_record)`` to run on every watchdog trip."""
+    with _trip_listeners_lock:
+        if fn not in _trip_listeners:
+            _trip_listeners.append(fn)
+
+
+def remove_trip_listener(fn) -> None:
+    with _trip_listeners_lock:
+        if fn in _trip_listeners:
+            _trip_listeners.remove(fn)
+
+
+def _notify_trip(rec: Dict) -> None:
+    with _trip_listeners_lock:
+        listeners = list(_trip_listeners)
+    for fn in listeners:
+        try:
+            fn(rec)
+        except Exception:
+            logger.exception("lock sanitizer: trip listener failed")
+
 
 def _site() -> str:
     """Allocation/acquisition site: first frame outside this module and
@@ -359,6 +390,7 @@ def _watchdog_loop(stop: threading.Event) -> None:
                 for tid, frame in sys._current_frames().items():
                     print(f"--- thread {tid} ---", file=sys.stderr)
                     traceback.print_stack(frame, file=sys.stderr)
+            _notify_trip(rec)
 
 
 # --------------------------------------------------------------------------

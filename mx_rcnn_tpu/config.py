@@ -588,6 +588,30 @@ class ObsConfig:
     sigusr2: bool = False
     # smoothing factor for the train.loss_ema gauge (per log window)
     loss_ema: float = 0.9
+    # --- time-series plane (obs/timeseries.py) ---------------------------
+    # ring-buffer sampler over the registry: counters→windowed rates,
+    # gauges, exact windowed histogram percentiles; the substrate the
+    # health engine and flight recorder read
+    timeseries: bool = False
+    sample_interval_s: float = 1.0   # sampler cadence
+    ts_capacity: int = 600           # ring depth (samples)
+    # --- SLO/health engine (obs/health.py) -------------------------------
+    # evaluate the default rule set after every sample; publish
+    # health.* gauges + runrec transitions + enriched /healthz
+    health: bool = False
+    health_window_s: float = 30.0    # default rule window (docs only —
+    # the stock rules carry per-rule windows; kept as the knob custom
+    # rule sets read)
+    # --- flight recorder (obs/flightrec.py) ------------------------------
+    # black-box dumps (runs/<id>/flight/) on crash / SIGTERM /
+    # lock-watchdog trip / health-critical transition
+    flight: bool = False
+    flight_window_s: float = 120.0   # how much sample history a dump keeps
+    flight_events: int = 512         # bounded event ring fed by runrec
+    # --- cross-process collection (obs/collect.py) -----------------------
+    # comma-separated /metrics URLs (host:port or full URL, optionally
+    # name=url) merged into the labeled fleet view by tools/obs.py
+    collect_urls: str = ""
 
 
 @dataclass(frozen=True)

@@ -289,6 +289,18 @@ class ElasticController:
             self._rec.observe("elastic.recovery_ms",
                               float(payload["recovery_ms"]),
                               lo=1.0, hi=600_000.0)
+        if event == "peer_failure":
+            # black-box the moment a collective partner dies: the
+            # flight record (obs/flightrec.py) holds the metric history
+            # and recent events leading into the EXIT_PEER_FAILURE,
+            # which the relaunched world's stdout can never show
+            try:
+                from mx_rcnn_tpu.obs import flightrec
+
+                flightrec.trigger("elastic-peer-failure", **payload)
+            except Exception:
+                logger.debug("elastic: flight trigger failed",
+                             exc_info=True)
 
 
 def parse_events(text: str):

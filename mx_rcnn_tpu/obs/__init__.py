@@ -24,11 +24,30 @@ the ``ft/`` snapshot path.  Layers, bottom-up:
   ``tools/serve.py`` run writes ``runs/<id>/events.jsonl`` plus a final
   BENCH-compatible ``summary.json``.
 
+The fleet-wide time-series plane (ISSUE 14) stacks on top:
+
+* ``timeseries.py`` — bounded ring-buffer store sampling the registry
+  (counters→windowed rates, gauges, exact windowed histogram
+  percentiles) on a daemon-thread cadence;
+* ``collect.py``    — cross-process aggregation: N replica/worker
+  registries (in-process or scraped over ``/metrics``) merged into one
+  source-labeled, generation-tagged fleet view;
+* ``health.py``     — declarative SLO rules over the time-series
+  windows → OK/WARN/CRITICAL verdict as gauges + runrec events +
+  enriched ``/healthz`` + exit codes (``tools/obs.py check``);
+* ``flightrec.py``  — black-box flight recorder: last-N-seconds of
+  samples + spans + events dumped to ``runs/<id>/flight/`` on crash,
+  SIGTERM, lock-watchdog trip, or a health-critical transition.
+
 Everything is DISABLED by default (``cfg.obs.enabled``); the disabled
-hot-path cost is pinned near zero by ``tests/test_obs.py``.
+hot-path cost is pinned near zero by ``tests/test_obs.py`` and the
+sampling-enabled overhead by ``tools/obs_smoke.py --overhead_out``
+(<2% acceptance bar, docs/obs_overhead.json).
 """
 
 from mx_rcnn_tpu.obs.metrics import (Histogram, LoweringCounter,  # noqa: F401
                                      Registry, ServeMetrics, registry,
                                      start_metrics_server)
 from mx_rcnn_tpu.obs.runrec import RunRecord  # noqa: F401
+from mx_rcnn_tpu.obs.timeseries import (Sampler,  # noqa: F401
+                                        TimeSeriesStore)

@@ -1,0 +1,267 @@
+"""Cross-process metric collection: N registries → one labeled fleet view.
+
+No reference equivalent.  Every plane built since PR 4 runs more than
+one registry: fleet replicas keep PRIVATE engine registries (so their
+``serve.*`` counters never double-count into the router's process
+registry — ``serve/fleet.py``), elastic workers are separate PROCESSES
+each with its own ``/metrics`` exporter, and the bulk driver is a third
+party again.  Nothing merged them; ``tools/obs.py watch``/``check`` and
+ROADMAP item 2's scheduler need exactly that merge.
+
+Two source kinds, one scrape contract — ``scrape() -> (snapshot,
+labels) | None``:
+
+* :class:`RegistrySource` — an IN-PROCESS registry, resolved through a
+  callable on every scrape so the source tracks object churn: a fleet
+  replica that ejects and relaunches gets a NEW engine (new registry,
+  bumped generation) and the next scrape simply follows it
+  (:func:`collector_for_fleet` wires this off ``router.manager``);
+* :class:`HttpSource` — a remote ``/metrics`` JSON endpoint (elastic
+  workers via ``cfg.obs.metrics_port``, any ``tools/serve.py`` front
+  end; ``cfg.obs.collect_urls`` is the CLI-facing list).
+
+A failed scrape marks the source ``up: false`` for that collection and
+nothing else — a mid-relaunch replica or a resized-away elastic worker
+degrades the view, never breaks the collector (the same
+survive-the-churn posture the router takes).
+
+:meth:`Collector.collect` returns the merged view::
+
+    {"ts": ..., "up": <n live>, "sources": {
+         "replica-0": {"up": true, "labels": {"source": "replica-0",
+                       "generation": 2, ...}, "counters": ..., "gauges":
+                       ..., "hists": ...},
+         ...},
+     "agg": {"counters": <summed across live sources>,
+             "gauges": {name: {source: value}}}}
+
+Counters SUM across sources (each source is a distinct registry, so the
+sum is the fleet total and can never double-count); gauges stay
+per-source labeled (summing ``fleet.replica0.depth`` across replicas
+would be nonsense) — consumers pick ``agg.counters`` for totals and
+``sources[...]`` for placement decisions.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+ScrapeResult = Optional[Tuple[Dict, Dict]]
+
+
+class RegistrySource:
+    """An in-process registry behind a per-scrape resolver.
+
+    ``resolve() -> (registry, labels) | None`` runs on EVERY scrape:
+    returning None means "down right now" (e.g. the replica is mid-
+    relaunch); returning a different registry object next time is the
+    expected relaunch behavior, not an error.  A bare registry is
+    accepted for the static case (tests, the train process's own
+    registry).
+    """
+
+    def __init__(self, name: str, registry_or_resolve,
+                 labels: Optional[Dict] = None):
+        self.name = name
+        self._static_labels = dict(labels or {})
+        if callable(registry_or_resolve):
+            self._resolve = registry_or_resolve
+        else:
+            reg = registry_or_resolve
+            self._resolve = lambda: (reg, {})
+
+    def scrape(self) -> ScrapeResult:
+        try:
+            resolved = self._resolve()
+        except Exception:
+            logger.exception("obs collect: source %s resolver failed",
+                             self.name)
+            return None
+        if resolved is None:
+            return None
+        reg, labels = resolved
+        if reg is None:
+            return None
+        snap = reg.snapshot()
+        merged = {"source": self.name, **self._static_labels,
+                  **(labels or {})}
+        return snap, merged
+
+
+class HttpSource:
+    """A remote ``/metrics`` JSON endpoint (the stdlib exporter's or the
+    serve front end's response body is ``Registry.snapshot`` shaped)."""
+
+    def __init__(self, name: str, url: str, timeout_s: float = 2.0,
+                 labels: Optional[Dict] = None):
+        self.name = name
+        if url.isdigit():  # bare port ("9101") = this host's exporter
+            url = f"127.0.0.1:{url}"
+        self.url = url if "://" in url else f"http://{url}"
+        if not self.url.rstrip("/").endswith("/metrics"):
+            self.url = self.url.rstrip("/") + "/metrics"
+        self.timeout_s = float(timeout_s)
+        self._static_labels = dict(labels or {})
+
+    def scrape(self) -> ScrapeResult:
+        try:
+            with urllib.request.urlopen(self.url,
+                                        timeout=self.timeout_s) as r:
+                snap = json.loads(r.read().decode())
+        except Exception as e:  # connection refused / timeout / bad JSON
+            logger.debug("obs collect: source %s (%s) down: %s",
+                         self.name, self.url, e)
+            return None
+        if not isinstance(snap, dict):
+            return None
+        # the serve front end nests the registry under "registry";
+        # normalize both shapes to Registry.snapshot
+        if "registry" in snap and "counters" not in snap:
+            snap = snap["registry"]
+        return snap, {"source": self.name, "url": self.url,
+                      **self._static_labels}
+
+
+class Collector:
+    """Merge N sources into one labeled view, churn-tolerant.
+
+    Sources add/remove under a lock (the fleet helper re-derives them
+    per collect); :meth:`collect` scrapes every source and never raises
+    — a down source is data (``up: false``), not an exception.
+    """
+
+    def __init__(self, sources: Optional[List] = None):
+        self._lock = threading.Lock()
+        self._sources: Dict[str, object] = {}
+        for s in sources or []:
+            self._sources[s.name] = s
+
+    def add(self, source) -> None:
+        with self._lock:
+            self._sources[source.name] = source
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._sources.pop(name, None)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._sources)
+
+    def collect(self) -> Dict:
+        with self._lock:
+            sources = list(self._sources.values())
+        view: Dict = {"ts": round(time.time(), 6), "sources": {}}
+        agg_counters: Dict[str, float] = {}
+        agg_gauges: Dict[str, Dict[str, float]] = {}
+        up = 0
+        for src in sources:
+            res = src.scrape()
+            if res is None:
+                view["sources"][src.name] = {"up": False}
+                continue
+            snap, labels = res
+            up += 1
+            view["sources"][src.name] = {
+                "up": True, "labels": labels,
+                "counters": snap.get("counters", {}),
+                "gauges": snap.get("gauges", {}),
+                "hists": snap.get("hists", {}),
+            }
+            for k, v in snap.get("counters", {}).items():
+                agg_counters[k] = agg_counters.get(k, 0) + v
+            for k, v in snap.get("gauges", {}).items():
+                agg_gauges.setdefault(k, {})[src.name] = v
+        view["up"] = up
+        view["agg"] = {"counters": agg_counters, "gauges": agg_gauges}
+        return view
+
+
+def collector_for_fleet(router, extra_sources: Optional[List] = None
+                        ) -> Collector:
+    """One source per managed replica, resolved through the replica
+    object each scrape — an eject reads down, a relaunch reads the NEW
+    engine's registry with the bumped generation label, a world resize
+    (different replica count after rebuild) is just a different source
+    set.  Plus the router's own process registry as ``router`` (the
+    fleet.* gauges live there, published by ``export_gauges``)."""
+    from mx_rcnn_tpu.obs.metrics import registry as process_registry
+
+    def replica_resolve(r):
+        with r._lock:
+            eng, gen, state = r.engine, r.generation, r.state
+        if eng is None:
+            return None
+        return eng.metrics.registry, {"generation": gen, "state": state}
+
+    sources: List = [
+        RegistrySource(f"replica-{r.id}",
+                       (lambda r=r: replica_resolve(r)))
+        for r in router.manager.replicas
+    ]
+    sources.append(RegistrySource("router", router.manager.registry
+                                  if router.manager.registry is not None
+                                  else process_registry()))
+    for s in extra_sources or []:
+        sources.append(s)
+    return Collector(sources)
+
+
+def view_to_snapshot(view: Dict) -> Dict:
+    """Collapse one collected view into a ``Registry.snapshot``-shaped
+    dict so windowed judgment can run over a FLEET the same way it runs
+    over a process (``tools/obs.py check`` appends these to a local
+    :class:`~mx_rcnn_tpu.obs.timeseries.TimeSeriesStore`).
+
+    Merge semantics, chosen conservative for SLO rules:
+
+    * counters — the agg SUM (fleet totals; sources are distinct
+      registries, so summing cannot double-count);
+    * gauges   — the bare name keeps the MIN across sources (a
+      readiness gauge judged fleet-wide must reflect the worst source)
+      and every per-source value survives as ``name@source``;
+    * hist summaries — counts sum; p50/p90/p99/max take the MAX across
+      sources (the fleet's tail is its worst source's tail).
+    """
+    gauges: Dict[str, float] = {}
+    for name, by_src in view["agg"]["gauges"].items():
+        gauges[name] = min(by_src.values())
+        for src, v in by_src.items():
+            gauges[f"{name}@{src}"] = v
+    hists: Dict[str, Dict] = {}
+    for src in view["sources"].values():
+        if not src.get("up"):
+            continue
+        for name, s in src.get("hists", {}).items():
+            if name not in hists:
+                hists[name] = dict(s)
+                continue
+            m = hists[name]
+            m["count"] = (m.get("count") or 0) + (s.get("count") or 0)
+            for k in ("p50", "p90", "p99", "max", "mean"):
+                a, b = m.get(k), s.get(k)
+                m[k] = b if a is None else (a if b is None else max(a, b))
+    return {"counters": dict(view["agg"]["counters"]),
+            "gauges": gauges, "hists": hists}
+
+
+def sources_from_urls(urls: str) -> List[HttpSource]:
+    """``cfg.obs.collect_urls`` / ``--url`` parsing: a comma-separated
+    list of ``host:port`` or full URLs, optionally ``name=url``."""
+    out: List[HttpSource] = []
+    for i, item in enumerate(s.strip() for s in urls.split(",")):
+        if not item:
+            continue
+        if "=" in item and "://" not in item.split("=", 1)[0]:
+            name, url = item.split("=", 1)
+        else:
+            name, url = f"source-{i}", item
+        out.append(HttpSource(name.strip(), url.strip()))
+    return out

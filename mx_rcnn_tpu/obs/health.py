@@ -1,0 +1,309 @@
+"""Declarative SLO rule engine over the time-series windows.
+
+No reference equivalent.  Everything before this judged health ad hoc:
+the fleet router's ``/healthz`` lists replica states but draws no
+conclusion, loadgen asserts SLOs only inside its own bench legs, and
+the gauges the elastic/fleet/bulk planes export (``docs/OBSERVABILITY.md``
+catalog) had no consumer.  This module is the judgment layer ROADMAP
+item 2's scheduler and item 3's canary gate will read: declarative
+rules over ``obs/timeseries.py`` windows, a machine-readable verdict,
+and exit-code semantics (``tools/obs.py check``).
+
+A :class:`Rule` names a query (``kind`` picks the store readout), a
+comparison and a severity::
+
+    Rule("serve-p99", metric="serve.total_ms", kind="p99",
+         op=">", threshold=1800.0, window_s=30, severity="critical")
+
+Kinds: ``gauge`` (latest value), ``gauge_min``/``gauge_max`` (window
+scan), ``rate``/``delta`` (counter windows), ``p99``/``p50`` (windowed
+histogram percentiles), ``ratio`` (``metric="a/b"`` — windowed counter
+delta ratio, e.g. shed fraction).  A metric the window never saw is NOT
+a breach (``missing_ok``): rules describe subsystems that may simply be
+off (no elastic world → no ``elastic.*`` gauges), and judging absence
+as failure would make every partial deployment CRITICAL.
+
+**Hysteresis** is per-rule: ``for_samples`` consecutive breaching
+evaluations fire the rule (a single bad sample — one slow scrape, one
+GC pause — never flaps the verdict), ``clear_samples`` consecutive
+clean ones clear it.  The asymmetry is deliberate: fire slow, clear
+slow, report instantly.
+
+Each evaluation publishes the verdict as gauges (``health.verdict`` 0/1/2,
+``health.rule.<name>`` 0/1 — scheduler-scrapeable like every other
+gauge), appends a ``health_transition`` runrec event on every verdict
+change, and invokes the transition callback — ``CliObs`` wires that to
+the flight recorder, so a CRITICAL transition dumps the black box
+(``obs/flightrec.py``).  The ACTIVE engine (:func:`set_active_engine`)
+enriches ``/healthz`` on both HTTP front ends.
+
+Verdict → exit code: OK=0, WARN=1, CRITICAL=2 (:data:`EXIT_BY_VERDICT`).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from mx_rcnn_tpu.obs.timeseries import TimeSeriesStore
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+OK, WARN, CRITICAL = "OK", "WARN", "CRITICAL"
+_LEVEL = {OK: 0, WARN: 1, CRITICAL: 2}
+EXIT_BY_VERDICT = dict(_LEVEL)
+
+_OPS: Dict[str, Callable[[float, float], bool]] = {
+    ">": lambda v, t: v > t,
+    ">=": lambda v, t: v >= t,
+    "<": lambda v, t: v < t,
+    "<=": lambda v, t: v <= t,
+}
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One SLO: fire ``severity`` when ``<kind>(metric, window_s) <op>
+    threshold`` holds for ``for_samples`` consecutive evaluations."""
+
+    name: str
+    metric: str           # for kind="ratio": "numerator/denominator"
+    kind: str             # gauge|gauge_min|gauge_max|rate|delta|p99|p50|ratio
+    op: str               # > >= < <=
+    threshold: float
+    window_s: float = 30.0
+    severity: str = WARN  # WARN or CRITICAL when firing
+    for_samples: int = 2  # consecutive breaches to fire (hysteresis)
+    clear_samples: int = 2  # consecutive cleans to clear
+    missing_ok: bool = True  # absent metric = no judgment, not a breach
+
+    def value(self, store: TimeSeriesStore) -> Optional[float]:
+        k = self.kind
+        if k == "gauge":
+            return store.gauge(self.metric)
+        if k == "gauge_min":
+            return store.gauge_min(self.metric, self.window_s)
+        if k == "gauge_max":
+            return store.gauge_max(self.metric, self.window_s)
+        if k == "rate":
+            return store.rate(self.metric, self.window_s)
+        if k == "delta":
+            return store.delta(self.metric, self.window_s)
+        if k in ("p99", "p50"):
+            return store.pctl(self.metric, float(k[1:]), self.window_s)
+        if k == "ratio":
+            num, den = self.metric.split("/", 1)
+            dn = store.delta(num.strip(), self.window_s)
+            dd = store.delta(den.strip(), self.window_s)
+            if dn is None or dd is None or dd <= 0:
+                return None
+            return dn / dd
+        raise ValueError(f"unknown rule kind {k!r}")
+
+
+class _RuleState:
+    __slots__ = ("breaches", "cleans", "firing")
+
+    def __init__(self):
+        self.breaches = 0
+        self.cleans = 0
+        self.firing = False
+
+
+class HealthEngine:
+    """Evaluate the rule set against a store; publish + notify.
+
+    Driven by the sampler thread (``Sampler(after_sample=engine.
+    evaluate_sample)``) or explicitly (:meth:`evaluate` — tests and
+    ``tools/obs.py check``).  All rule state lives under one lock; the
+    transition callback and runrec write run OUTSIDE it (they do I/O).
+    """
+
+    def __init__(self, rules: List[Rule], store: TimeSeriesStore,
+                 registry=None, record=None,
+                 on_transition: Optional[Callable[[str, str, Dict],
+                                                  None]] = None):
+        self.rules = list(rules)
+        self.store = store
+        self._reg = registry
+        self._record = record
+        self._on_transition = on_transition
+        self._lock = threading.Lock()
+        self._state = {r.name: _RuleState() for r in self.rules}
+        self._verdict = OK
+        self._last: Optional[Dict] = None
+
+    # ------------------------------------------------------------------
+
+    def evaluate(self) -> Dict:
+        """One pass over every rule; returns (and retains) the verdict
+        document.  Never raises: a rule whose query blows up reads as
+        value None (missing), logged once per pass."""
+        rule_out: List[Dict] = []
+        fired: List[str] = []
+        worst = OK
+        with self._lock:
+            for r in self.rules:
+                try:
+                    v = r.value(self.store)
+                except Exception:
+                    logger.exception("obs health: rule %s query failed",
+                                     r.name)
+                    v = None
+                st = self._state[r.name]
+                if v is None:
+                    # absent metric: hold state, judge nothing
+                    breach = None
+                else:
+                    breach = _OPS[r.op](float(v), r.threshold)
+                    if breach:
+                        st.breaches += 1
+                        st.cleans = 0
+                        if st.breaches >= r.for_samples:
+                            st.firing = True
+                    else:
+                        st.cleans += 1
+                        st.breaches = 0
+                        if st.cleans >= r.clear_samples:
+                            st.firing = False
+                if st.firing:
+                    fired.append(r.name)
+                    if _LEVEL[r.severity] > _LEVEL[worst]:
+                        worst = r.severity
+                rule_out.append({
+                    "name": r.name, "metric": r.metric, "kind": r.kind,
+                    "op": r.op, "threshold": r.threshold,
+                    "window_s": r.window_s, "severity": r.severity,
+                    "value": None if v is None else round(float(v), 4),
+                    "breaching": breach, "firing": st.firing,
+                })
+            prev = self._verdict
+            self._verdict = worst
+            verdict = {"verdict": worst, "code": _LEVEL[worst],
+                       "previous": prev, "changed": worst != prev,
+                       "firing": fired, "rules": rule_out}
+            self._last = verdict
+        self._publish(verdict)
+        if verdict["changed"]:
+            self._notify(prev, worst, verdict)
+        return verdict
+
+    def evaluate_sample(self, _sample: Dict) -> None:
+        """``Sampler(after_sample=...)`` adapter."""
+        self.evaluate()
+
+    # ------------------------------------------------------------------
+
+    def _publish(self, verdict: Dict) -> None:
+        if self._reg is None:
+            return
+        try:
+            self._reg.set_gauge("health.verdict", verdict["code"])
+            for r in verdict["rules"]:
+                self._reg.set_gauge(f"health.rule.{r['name']}",
+                                    1.0 if r["firing"] else 0.0)
+        except Exception:
+            logger.exception("obs health: gauge publish failed")
+
+    def _notify(self, prev: str, new: str, verdict: Dict) -> None:
+        logger.log(logging.WARNING if _LEVEL[new] else logging.INFO,
+                   "health verdict %s -> %s (firing: %s)", prev, new,
+                   ",".join(verdict["firing"]) or "-")
+        if self._record is not None:
+            try:
+                self._record.event("health_transition", prev=prev,
+                                   verdict=new, firing=verdict["firing"])
+            except Exception:
+                logger.exception("obs health: transition event failed")
+        if self._on_transition is not None:
+            try:
+                self._on_transition(prev, new, verdict)
+            except Exception:
+                logger.exception("obs health: transition callback "
+                                 "failed")
+
+    # ------------------------------------------------------------------
+
+    @property
+    def verdict(self) -> str:
+        with self._lock:
+            return self._verdict
+
+    def last(self) -> Optional[Dict]:
+        """The most recent verdict document (None before the first
+        evaluation) — the ``/healthz`` enrichment body."""
+        with self._lock:
+            return self._last
+
+    def exit_code(self) -> int:
+        return _LEVEL[self.verdict]
+
+
+def default_rules(cfg) -> List[Rule]:
+    """The stock SLO set over the gauges the planes already export
+    (thresholds from the config the run actually used).  Every rule is
+    ``missing_ok`` — a train-only run simply never resolves the fleet
+    rules and vice versa."""
+    deadline = cfg.serve.default_timeout_ms or 2000.0
+    w = cfg.obs.health_window_s
+    return [
+        # serve p99 vs the request-deadline budget: p99 at 90% of the
+        # deadline means the tail is about to start expiring
+        Rule("serve-p99-budget", "serve.total_ms", "p99", ">",
+             0.9 * deadline, window_s=w, severity=CRITICAL),
+        # shed fraction over the window (terminated-by-shedding / all
+        # submitted); sustained shedding = capacity, not noise
+        Rule("serve-shed-frac", "serve.shed/serve.submitted", "ratio",
+             ">", 0.05, window_s=w, severity=WARN),
+        # fleet capacity: ready below the CONFIGURED size is CRITICAL —
+        # the router masks a dead replica by rerouting, so judged
+        # health must not (a scheduler that waits for total blackout
+        # acts one failure too late).  Reads the latest gauge with
+        # 1-sample hysteresis: replicas_ready is a state readout, not a
+        # noisy measurement, and the relaunch clears it the same way
+        # (the CRITICAL→OK transition make health-smoke pins)
+        Rule("fleet-degraded", "fleet.replicas_ready", "gauge", "<",
+             float(cfg.fleet.replicas), window_s=15.0,
+             severity=CRITICAL, for_samples=1, clear_samples=1),
+        # elastic recovery: resumes should land within the snapshot
+        # cadence budget
+        Rule("elastic-recovery", "elastic.recovery_ms", "p99", ">",
+             60_000.0, window_s=300.0, severity=WARN),
+        # input-bound training: the step loop waiting on data more than
+        # half the time means the loader, not the model, sets the speed
+        Rule("train-data-wait", "train.data_wait_frac", "gauge", ">",
+             0.5, window_s=60.0, severity=WARN),
+        # snapshot stalls: the async snapshotter should never hold the
+        # step loop for a macroscopic pause
+        Rule("snapshot-stall", "snapshot.stall_ms", "p99", ">",
+             1000.0, window_s=120.0, severity=WARN),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# active-engine registration (the /healthz enrichment hook)
+# ---------------------------------------------------------------------------
+
+_active_lock = threading.Lock()
+_ACTIVE: Optional[HealthEngine] = None
+
+
+def set_active_engine(engine: Optional[HealthEngine]) -> None:
+    global _ACTIVE
+    with _active_lock:
+        _ACTIVE = engine
+
+
+def active_engine() -> Optional[HealthEngine]:
+    with _active_lock:
+        return _ACTIVE
+
+
+def active_verdict() -> Optional[Dict]:
+    """The current verdict document, if a health engine is live in this
+    process — what ``serve/server.py`` and the ``/metrics`` exporter
+    attach to ``/healthz``."""
+    eng = active_engine()
+    return None if eng is None else eng.last()

@@ -359,9 +359,28 @@ class _MetricsHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path == "/metrics":
-            self._reply(200, self.server.registry.snapshot())
+            snap = self.server.registry.snapshot()
+            # when the time-series plane is armed (cfg.obs.timeseries →
+            # CliObs sets the active store) one scrape also answers
+            # "what moved lately" — pure host-side work, no lowerings
+            # (asserted by make obs-smoke)
+            from mx_rcnn_tpu.obs.timeseries import active
+
+            store = active()
+            if store is not None:
+                snap["timeseries"] = store.scrape_section()
+            self._reply(200, snap)
         elif self.path == "/healthz":
-            self._reply(200, {"ok": True})
+            from mx_rcnn_tpu.obs.health import active_verdict
+
+            payload = {"ok": True}
+            verdict = active_verdict()
+            if verdict is not None:
+                payload["ok"] = verdict["verdict"] != "CRITICAL"
+                payload["health"] = verdict
+            # a CRITICAL verdict fails the probe (matches the serving
+            # plane's /healthz: load balancers key on the status code)
+            self._reply(200 if payload["ok"] else 503, payload)
         else:
             self._reply(404, {"error": f"no such path {self.path!r}"})
 

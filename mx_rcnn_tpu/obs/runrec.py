@@ -86,6 +86,7 @@ class RunRecord:
         self._lock = threading.Lock()
         self._n = 0
         self._dead = False
+        self._listeners = []
         try:
             os.makedirs(self.dir, exist_ok=True)
             # persistlint: disable=PL101 append-only event stream with a LINE-GRANULAR crash contract (module docstring): each line is kernel-flushed, readers tolerate a torn tail line, close() fsyncs; an atomic rewrite per event would put disk latency on the hot path
@@ -96,6 +97,22 @@ class RunRecord:
             self._f, self._dead = None, True
         self.event("run_start", kind=kind, pid=os.getpid(),
                    argv=list(sys.argv))
+
+    def add_listener(self, fn) -> None:
+        """Register ``fn(event_dict)`` to observe every event as it is
+        emitted — the flight recorder (``obs/flightrec.py``) rings the
+        run's events into its black box this way, with zero extra
+        instrumentation at the emit sites.  Listeners run on the
+        emitting thread and must be cheap; exceptions log and never
+        propagate into the emitter."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
 
     def event(self, event: str, **payload) -> None:
         """Append one event line (flushed immediately); never raises."""
@@ -108,6 +125,13 @@ class RunRecord:
             logger.warning("obs runrec: unserializable event %r: %s",
                            event, e)
             return
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(rec)
+            except Exception:
+                logger.exception("obs runrec: event listener failed")
         with self._lock:
             if self._dead:
                 return
@@ -196,6 +220,10 @@ class CliObs:
         self.record = RunRecord(kind, base_dir=cfg.obs.run_dir)
         logger.info("obs: run record -> %s", self.record.dir)
         self._metrics_srv = None
+        self.store = None
+        self.sampler = None
+        self.health = None
+        self.flight = None
         try:
             from mx_rcnn_tpu.obs import trace as obs_trace
 
@@ -213,11 +241,61 @@ class CliObs:
         except Exception:
             logger.exception("obs: CLI wiring failed — continuing "
                              "without the failed piece")
+        # time-series plane (obs/timeseries.py + health.py + flightrec
+        # .py — docs/OBSERVABILITY.md "Time-series plane"): the sampler
+        # drives the ring store AND the health engine on one daemon
+        # thread; the flight recorder rings runrec events and arms the
+        # crash/SIGTERM/watchdog triggers.  Same fail-soft posture as
+        # the block above.
+        try:
+            if (cfg.obs.timeseries or cfg.obs.health
+                    or cfg.obs.flight):
+                from mx_rcnn_tpu.obs import timeseries as obs_ts
+                from mx_rcnn_tpu.obs.metrics import registry
+
+                self.store = obs_ts.TimeSeriesStore(cfg.obs.ts_capacity)
+                obs_ts.set_active(self.store)
+                if cfg.obs.flight:
+                    from mx_rcnn_tpu.obs import flightrec
+
+                    self.flight = flightrec.FlightRecorder(
+                        self.store, self.record.dir,
+                        window_s=cfg.obs.flight_window_s,
+                        max_events=cfg.obs.flight_events)
+                    self.record.add_listener(self.flight.note_event)
+                    flightrec.set_active(self.flight)
+                    self.flight.arm(
+                        signals=threading.current_thread()
+                        is threading.main_thread())
+                if cfg.obs.health:
+                    from mx_rcnn_tpu.obs import health as obs_health
+
+                    self.health = obs_health.HealthEngine(
+                        obs_health.default_rules(cfg), self.store,
+                        registry=registry(), record=self.record,
+                        on_transition=(self.flight.on_health_transition
+                                       if self.flight else None))
+                    obs_health.set_active_engine(self.health)
+                self.sampler = obs_ts.Sampler(
+                    self.store, interval_s=cfg.obs.sample_interval_s,
+                    after_sample=(self.health.evaluate_sample
+                                  if self.health else None))
+                self.sampler.start()
+        except Exception:
+            logger.exception("obs: time-series wiring failed — "
+                             "continuing without the failed piece")
 
     def close(self, metric: Optional[str] = None, value=None,
               unit: Optional[str] = None, **extra) -> None:
         """Export the chrome trace (if spans were collected), write the
         BENCH summary, stop the exporter.  Never raises."""
+        try:
+            if self.sampler is not None:
+                # one last sample (and health pass) so the ring's tail
+                # reflects shutdown state before the summary snapshots
+                self.sampler.stop(final_sample=True)
+        except Exception:
+            logger.exception("obs: sampler stop failed")
         try:
             from mx_rcnn_tpu.obs import trace as obs_trace
 
@@ -232,6 +310,22 @@ class CliObs:
         except Exception:
             logger.exception("obs: run summary write failed")
         self.record.close()
+        try:
+            if self.flight is not None:
+                from mx_rcnn_tpu.obs import flightrec
+
+                self.flight.disarm()
+                flightrec.set_active(None)
+            if self.health is not None:
+                from mx_rcnn_tpu.obs import health as obs_health
+
+                obs_health.set_active_engine(None)
+            if self.store is not None:
+                from mx_rcnn_tpu.obs import timeseries as obs_ts
+
+                obs_ts.set_active(None)
+        except Exception:
+            logger.exception("obs: time-series teardown failed")
         if self._metrics_srv is not None:
             try:
                 self._metrics_srv.shutdown()

@@ -236,12 +236,17 @@ class BulkRunner:
 
     def __init__(self, router, loader, sink: BulkSink, cfg: Config,
                  registry=None,
-                 fault: Optional[Callable[[int], None]] = None):
+                 fault: Optional[Callable[[int], None]] = None,
+                 record=None):
         self.router = router
         self.loader = loader
         self.sink = sink
         self.cfg = cfg
         self.rec = registry
+        # optional RunRecord (obs/runrec.py): shard commits and aborts
+        # land in runs/<id>/events.jsonl like every other entry point
+        # (tools/bulk.py wires it)
+        self.run_record = record
         self.fault = fault
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -379,6 +384,10 @@ class BulkRunner:
                         "bulk.imgs_per_s",
                         round(self.committed_images
                               / max(time.perf_counter() - t0, 1e-9), 2))
+                if self.run_record is not None:
+                    self.run_record.event("bulk_shard_commit", shard=k,
+                                          images=len(lines),
+                                          commit_ms=round(commit_ms, 3))
                 if self.fault is not None:
                     self.fault(k)
         except BaseException as e:  # noqa: BLE001 — re-raised in run()
@@ -473,6 +482,19 @@ class BulkRunner:
                 self._feeding_done = True
                 self._cond.notify_all()
         if self._error is not None:
+            if self.run_record is not None:
+                self.run_record.event("bulk_abort",
+                                      error=repr(self._error)[:500],
+                                      committed_shards=self.committed_shards)
+            # black-box the abort: the flight record holds the bulk.*
+            # gauge history and retry events leading into it
+            try:
+                from mx_rcnn_tpu.obs import flightrec
+
+                flightrec.trigger("bulk-abort",
+                                  error=repr(self._error)[:500])
+            except Exception:
+                logger.debug("bulk: flight trigger failed", exc_info=True)
             raise self._error
         wall = time.perf_counter() - t0
         accounted = resumed_images + self.committed_images

@@ -209,7 +209,7 @@ class ReplicaManager:
     """
 
     def __init__(self, build_fn: Callable[[int], Tuple[ServingEngine, Dict]],
-                 cfg: Config, registry: Registry = None):
+                 cfg: Config, registry: Registry = None, record=None):
         if cfg.fleet.replicas < 1:
             raise ValueError(
                 f"fleet.replicas must be >= 1, got {cfg.fleet.replicas}")
@@ -217,6 +217,11 @@ class ReplicaManager:
         self.replicas = [Replica(i, build_fn)
                          for i in range(cfg.fleet.replicas)]
         self.registry = registry or process_registry()
+        # optional RunRecord (obs/runrec.py): eject/rejoin land in
+        # runs/<id>/events.jsonl — and through the record's listener
+        # hook in the flight recorder's black box, so a kill-mid-burst
+        # dump names the ejected replica (tools/fleet.py wires it)
+        self.record = record
         self.ejects = 0
         self.relaunches = 0
         # eject (health-monitor thread) and relaunch (per-replica rebuild
@@ -310,6 +315,9 @@ class ReplicaManager:
             served = eng.metrics.counters["served"]
         logger.warning("replica %d ejected (%s) after serving %d "
                        "requests this generation", r.id, reason, served)
+        if self.record is not None:
+            self.record.event("fleet_eject", replica=r.id, reason=reason,
+                              generation=r.generation, served=served)
         self._schedule_relaunch(r, (reason,), made_progress=served > 0)
 
     def _schedule_relaunch(self, r: Replica, signature: tuple,
@@ -332,6 +340,9 @@ class ReplicaManager:
         if r.launch():
             r.policy.record(("rejoined",), made_progress=True)
             logger.info("replica %d rejoined the fleet", r.id)
+            if self.record is not None:
+                self.record.event("fleet_rejoin", replica=r.id,
+                                  generation=r.generation)
         else:
             self._schedule_relaunch(r, ("launch-failed",),
                                     made_progress=False)
@@ -639,11 +650,12 @@ def make_engine_build_fn(cfg: Config, model, variables, *,
 
 def build_fleet(cfg: Config, model, variables, *, export_root: str = None,
                 run_fn_factory=None, devices=None,
-                registry: Registry = None) -> FleetRouter:
+                registry: Registry = None, record=None) -> FleetRouter:
     """One-call fleet: manager + router, replicas launched and warmed."""
     build = make_engine_build_fn(cfg, model, variables,
                                  export_root=export_root,
                                  run_fn_factory=run_fn_factory,
                                  devices=devices)
-    manager = ReplicaManager(build, cfg, registry=registry).start()
+    manager = ReplicaManager(build, cfg, registry=registry,
+                             record=record).start()
     return FleetRouter(manager, cfg)

@@ -110,6 +110,16 @@ class DetectionHandler(BaseHTTPRequestHandler):
         engine: ServingEngine = self.server.engine
         if self.path == "/healthz":
             h = engine.healthz()
+            # SLO-engine enrichment (obs/health.py): when a health
+            # engine is live in this process, /healthz carries the
+            # judged verdict on top of the raw liveness report, and a
+            # CRITICAL verdict fails the probe
+            from mx_rcnn_tpu.obs.health import active_verdict
+
+            verdict = active_verdict()
+            if verdict is not None:
+                h["health"] = verdict
+                h["ok"] = h["ok"] and verdict["verdict"] != "CRITICAL"
             self._reply(200 if h["ok"] else 503, h)
         elif self.path == "/metrics":
             # the serving snapshot in its original (bench-pinned) format,
@@ -118,6 +128,11 @@ class DetectionHandler(BaseHTTPRequestHandler):
             # (cfg.obs.enabled), this one scrape is the unified view
             snap = engine.metrics.snapshot()
             snap["registry"] = engine.metrics.registry.snapshot()
+            from mx_rcnn_tpu.obs.timeseries import active
+
+            store = active()
+            if store is not None:
+                snap["timeseries"] = store.scrape_section()
             self._reply(200, snap)
         else:
             self._reply(404, {"error": f"no such path {self.path!r}"})

@@ -173,6 +173,7 @@ def run_single(args, cfg) -> int:
     BENCH record and returns the --check exit code."""
     from mx_rcnn_tpu.data.loader import StreamTestLoader
     from mx_rcnn_tpu.obs.metrics import LoweringCounter, registry
+    from mx_rcnn_tpu.obs.runrec import cli_obs
     from mx_rcnn_tpu.serve.bulk import (BulkRunner, BulkSink, auto_inflight,
                                         make_sink_manifest)
     from mx_rcnn_tpu.serve.export import (CACHE_SUBDIR, ExportStore,
@@ -199,10 +200,16 @@ def run_single(args, cfg) -> int:
         cfg, quant_fingerprint=getattr(predictor, "quant_fingerprint",
                                        None))
 
+    # obs (off by default): run record under runs/<id>/ like every
+    # other entry point, plus — when enabled — the time-series sampler,
+    # health engine and flight recorder (docs/OBSERVABILITY.md)
+    obs_sess = cli_obs(cfg, "bulk")
+    record = obs_sess.record if obs_sess else None
+
     logger.info("[bulk] launching %d export-warmed replica(s) ...",
                 cfg.fleet.replicas)
     router = build_fleet(cfg, predictor.model, predictor.variables,
-                         export_root=store_root)
+                         export_root=store_root, record=record)
     rec = {
         "metric": "bulk_imgs_per_sec",
         "unit": "imgs/s",
@@ -246,7 +253,8 @@ def run_single(args, cfg) -> int:
                                            model=_model_ident(args)))
         runner = BulkRunner(router, loader, sink, cfg,
                             registry=registry(),
-                            fault=parse_fault(args.fault))
+                            fault=parse_fault(args.fault),
+                            record=record)
         logger.info("[bulk] scoring %d images → %s (resume cursor: %d "
                     "shard(s))", len(roidb), args.out_dir,
                     sink.committed_shards())
@@ -285,6 +293,10 @@ def run_single(args, cfg) -> int:
         problems += [k for k, v in checks.items() if not v]
     finally:
         router.close()
+        if obs_sess is not None:
+            obs_sess.close(metric=rec["metric"], value=rec.get("value"),
+                           unit=rec.get("unit"),
+                           checks=rec.get("checks"))
 
     print(json.dumps(rec), flush=True)
     if args.out:
@@ -341,11 +353,21 @@ def run_kill_resume(args, cfg) -> int:
     """The acceptance protocol: control → kill-at-mid-shard → resume →
     byte-compare.  Children are REAL processes (SIGKILL must be real);
     they share one export store and one materialized corpus."""
+    from mx_rcnn_tpu.obs.runrec import cli_obs
     from mx_rcnn_tpu.serve.bulk import BulkSink
     from mx_rcnn_tpu.serve.export import (CACHE_SUBDIR,
                                           enable_compile_cache,
                                           export_serve_programs)
     from mx_rcnn_tpu.tools.loadgen import init_predictor
+
+    # the parent orchestrator gets its own run record (children write
+    # theirs): the three phase events + the final byte-identity verdict
+    # make the protocol's runs/<id>/ self-describing
+    obs_sess = cli_obs(cfg, "bulk_kill_resume")
+
+    def _phase(name: str, **kw) -> None:
+        if obs_sess is not None:
+            obs_sess.record.event("bulk_protocol_phase", phase=name, **kw)
 
     # materialize corpus + export store ONCE, in the parent, so children
     # never race the PNG writes or the export verify pass
@@ -380,6 +402,7 @@ def run_kill_resume(args, cfg) -> int:
 
     logger.info("[bulk] CONTROL run (uninterrupted, with serve "
                 "baseline) → %s", ctrl_dir)
+    _phase("control", out_dir=ctrl_dir)
     rc, ctrl, out = _run_child(_child_cmd(args, ctrl_dir, store,
                                           baseline=True))
     rec["control"] = ctrl
@@ -390,6 +413,7 @@ def run_kill_resume(args, cfg) -> int:
 
     logger.info("[bulk] KILL run (SIGKILL after shard %d) → %s",
                 kill_shard, kill_dir)
+    _phase("kill", out_dir=kill_dir, kill_after_shard=kill_shard)
     rc, _, out = _run_child(_child_cmd(
         args, kill_dir, store, fault=f"kill@shard={kill_shard}"))
     killed_by_signal = rc in (-signal.SIGKILL, 128 + signal.SIGKILL, 137)
@@ -411,6 +435,8 @@ def run_kill_resume(args, cfg) -> int:
                         "shards — not a mid-corpus kill")
 
     logger.info("[bulk] RESUME run (same sink) ...")
+    _phase("resume", out_dir=kill_dir,
+           committed_at_kill=committed_at_kill)
     rc, resume, out = _run_child(_child_cmd(args, kill_dir, store))
     rec["resume"] = resume
     if rc != 0 or resume is None:
@@ -447,6 +473,9 @@ def run_kill_resume(args, cfg) -> int:
         rec["value"] = ctrl.get("value")
         rec["unit"] = "imgs/s"
     problems += [k for k, v in checks.items() if not v]
+    if obs_sess is not None:
+        obs_sess.close(metric=rec["metric"], value=rec.get("value"),
+                       unit=rec.get("unit"), checks=checks)
 
     print(json.dumps(rec), flush=True)
     if args.out:

@@ -115,16 +115,26 @@ def cmd_export(args) -> int:
     cfg = _config(args)
     import os
 
+    from mx_rcnn_tpu.obs.runrec import cli_obs
+
     # the verify pass compiles every exported program — pointing the
     # persistent cache INTO the store makes those compiles the cache
     # entries a joining replica will read
     enable_compile_cache(os.path.join(args.out, CACHE_SUBDIR))
-    predictor = _init_predictor(cfg, args)
-    t0 = time.perf_counter()
-    report = export_serve_programs(predictor, cfg, args.out,
-                                   eval_batch=args.eval_batch,
-                                   verify=not args.no_verify)
-    report["export_s"] = round(time.perf_counter() - t0, 2)
+    obs_sess = cli_obs(cfg, "fleet_export")
+    report = None
+    try:
+        predictor = _init_predictor(cfg, args)
+        t0 = time.perf_counter()
+        report = export_serve_programs(predictor, cfg, args.out,
+                                       eval_batch=args.eval_batch,
+                                       verify=not args.no_verify)
+        report["export_s"] = round(time.perf_counter() - t0, 2)
+    finally:
+        if obs_sess is not None:
+            obs_sess.close(metric="fleet_export_s",
+                           value=(report or {}).get("export_s"),
+                           unit="s", store=args.out)
     print(json.dumps(report))
     return 0
 
@@ -182,7 +192,12 @@ def cmd_serve(args) -> int:
                 f"export-warm from {export_dir}" if export_dir
                 else "trace-warm")
     router = build_fleet(cfg, model, variables,
-                         export_root=export_dir or None)
+                         export_root=export_dir or None,
+                         record=obs_sess.record if obs_sess else None)
+    if obs_sess is not None and obs_sess.flight is not None:
+        # a flight record from this process should carry the fleet's
+        # shape at dump time, not just its metrics
+        obs_sess.flight.add_context("fleet", router.healthz)
     names = args.class_names.split(",") if args.class_names else None
     srv = make_server(router, args.host, args.port, class_names=names)
     host, port = srv.server_address[:2]
@@ -228,8 +243,10 @@ def cmd_join_bench(args) -> int:
         except Exception:
             pass
 
+    from mx_rcnn_tpu.obs.runrec import cli_obs
     from mx_rcnn_tpu.serve.engine import ServingEngine
 
+    obs_sess = cli_obs(cfg, "join_bench")
     t_start = time.perf_counter()
     predictor = _init_predictor(cfg, args)
     t_build = time.perf_counter() - t_start
@@ -256,7 +273,7 @@ def cmd_join_bench(args) -> int:
     exec_s = sum(second)
     overhead_s = sum(max(a - b, 0.0) for a, b in zip(first, second)) \
         + join.get("load_s", 0.0)
-    print(json.dumps({
+    doc = {
         "mode": args.mode,
         "build_s": round(t_build, 3),
         "warm_s": round(warm_s, 3),
@@ -265,7 +282,11 @@ def cmd_join_bench(args) -> int:
         "total_s": round(time.perf_counter() - t_start, 3),
         "programs": engine.program_count(),
         **{k: v for k, v in join.items() if k in ("load_s",)},
-    }))
+    }
+    if obs_sess is not None:
+        obs_sess.close(metric="join_total_s", value=doc["total_s"],
+                       unit="s", mode=args.mode)
+    print(json.dumps(doc))
     return 0
 
 

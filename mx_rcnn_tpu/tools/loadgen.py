@@ -266,14 +266,15 @@ def make_content_stub_run_fn(cfg: Config, model_ms: float = 0.0):
 
 
 def _build_fleet(cfg: Config, replicas: int, model, variables, *,
-                 export_root: str = None, stub_ms: float = None):
+                 export_root: str = None, stub_ms: float = None,
+                 record=None):
     from mx_rcnn_tpu.serve.fleet import build_fleet
 
     fcfg = cfg.replace_in("fleet", replicas=replicas)
     factory = (None if stub_ms is None
                else (lambda rid: make_stub_run_fn(fcfg, stub_ms)))
     return build_fleet(fcfg, model, variables, export_root=export_root,
-                       run_fn_factory=factory)
+                       run_fn_factory=factory, record=record)
 
 
 def _drain(target, timeout_s: float = 30.0) -> None:
@@ -781,6 +782,14 @@ def main(argv=None) -> int:
     timeout_ms = (cfg.serve.default_timeout_ms if args.timeout_ms is None
                   else args.timeout_ms)
 
+    # obs (off by default): the loadgen is an entry point like any
+    # other — a bench run with obs on gets a runs/<id>/ record and,
+    # with the time-series plane on, sampled windows over the window
+    # it measures (docs/OBSERVABILITY.md)
+    from mx_rcnn_tpu.obs.runrec import cli_obs
+
+    obs_sess = cli_obs(cfg, "loadgen")
+
     predictor = init_predictor(cfg, args.prefix, args.epoch, args.seed)
     images = synthetic_images(cfg, args.images, args.seed)
 
@@ -790,7 +799,9 @@ def main(argv=None) -> int:
                     if args.export_dir else "trace-warm")
         engine = _build_fleet(cfg, args.fleet, predictor.model,
                               predictor.variables,
-                              export_root=args.export_dir)
+                              export_root=args.export_dir,
+                              record=obs_sess.record if obs_sess
+                              else None)
         off = None  # offline baseline is a single-engine concept
     else:
         engine = ServingEngine(predictor, cfg)
@@ -859,6 +870,9 @@ def main(argv=None) -> int:
         "recompiles_after_warmup": lc.n,
         "client_outcomes": run["client"],
     }
+    if obs_sess is not None:
+        obs_sess.close(metric=rec["metric"], value=rec["value"],
+                       unit=rec["unit"], mode=args.mode)
     print(json.dumps(rec))
     if args.out:
         with open(args.out, "w") as f:

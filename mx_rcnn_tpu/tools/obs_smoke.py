@@ -74,6 +74,7 @@ def run_smoke(workdir: str, num_images: int, epochs: int) -> dict:
 
     from mx_rcnn_tpu.core.tester import Predictor
     from mx_rcnn_tpu.models import build_model
+    from mx_rcnn_tpu.obs import timeseries as obs_ts
     from mx_rcnn_tpu.obs import trace as obs_trace
     from mx_rcnn_tpu.obs.metrics import (LoweringCounter, ServeMetrics,
                                          registry, start_metrics_server)
@@ -83,13 +84,23 @@ def run_smoke(workdir: str, num_images: int, epochs: int) -> dict:
     from mx_rcnn_tpu.tools.train import train_net
 
     cfg = _cfg(workdir, enabled=True, trace=True, profile_at_step=3,
-               profile_steps=2, run_dir=os.path.join(workdir, "runs"))
+               profile_steps=2, run_dir=os.path.join(workdir, "runs"),
+               timeseries=True, sample_interval_s=0.25)
     registry().reset()
     obs_trace.enable(cfg.obs.trace_cap)
     obs_trace.reset()
     run_rec = RunRecord("train", base_dir=cfg.obs.run_dir)
     srv = start_metrics_server(port=0)
     port = srv.server_address[1]
+    # time-series plane (ISSUE 14): the sampler rings the registry for
+    # the whole observed run, so the SAME scrape must carry the
+    # "timeseries" section — and (check()) adds ZERO lowerings, since
+    # sampling is pure host work
+    store = obs_ts.TimeSeriesStore(cfg.obs.ts_capacity)
+    obs_ts.set_active(store)
+    sampler = obs_ts.Sampler(store,
+                             interval_s=cfg.obs.sample_interval_s)
+    sampler.start()
     lc = LoweringCounter()
     lc.__enter__()
     lowerings_at_epoch = []
@@ -121,6 +132,8 @@ def run_smoke(workdir: str, num_images: int, epochs: int) -> dict:
                        value=registry().counter("train.steps"),
                        unit="steps")
     finally:
+        sampler.stop(final_sample=True)
+        obs_ts.set_active(None)
         run_rec.close()
         srv.shutdown()
         srv.server_close()
@@ -166,6 +179,18 @@ def check(ev: dict) -> list:
     if snap.get("counters", {}).get("serve.served", 0) < 1:
         problems.append("serve burst served nothing")
 
+    # time-series plane (ISSUE 14): the same scrape carries the ring
+    # store's windowed section while a store is active — and the steady
+    # -state zero-lowering check below runs with the sampler LIVE, so a
+    # sampler that lowered anything would fail that assertion
+    ts = snap.get("timeseries")
+    if not isinstance(ts, dict):
+        problems.append("/metrics scrape has no timeseries section "
+                        "while a store is active")
+    elif ts.get("samples", 0) < 2:
+        problems.append(f"timeseries store sampled {ts.get('samples')} "
+                        "times over the whole observed run")
+
     if not ev["events"]:
         problems.append("events.jsonl empty")
     for i, e in enumerate(ev["events"]):
@@ -204,22 +229,40 @@ def check(ev: dict) -> list:
 
 def measure_overhead(workdir: str, num_images: int) -> dict:
     """Enabled-vs-disabled steady-state step time (the <2% acceptance
-    number recorded in docs/obs_overhead.json).  Per-step wall clocks via
-    ``step_callback``; the first 4 steps (compiles, one per shape bucket
-    plus warm-up jitter) are excluded; median over the rest."""
+    number recorded in docs/obs_overhead.json).  The enabled arm runs
+    the FULL plane as ISSUE 14 wires it — spans + registry + the
+    time-series sampler ticking at ``sample_interval_s`` + the health
+    engine judging every sample — against a nothing-enabled arm.
+    Per-step wall clocks via ``step_callback``; the first 4 steps
+    (compiles, one per shape bucket plus warm-up jitter) are excluded;
+    median over the rest."""
     import numpy as np
 
+    from mx_rcnn_tpu.obs import health as obs_health
+    from mx_rcnn_tpu.obs import timeseries as obs_ts
     from mx_rcnn_tpu.obs import trace as obs_trace
     from mx_rcnn_tpu.obs.metrics import registry
     from mx_rcnn_tpu.tools.train import train_net
 
     def arm(enabled: bool, tag: str) -> float:
         cfg = _cfg(workdir, enabled=enabled, trace=enabled,
+                   timeseries=enabled, sample_interval_s=0.25,
+                   health=enabled,
                    run_dir=os.path.join(workdir, "runs"))
+        sampler = None
         if enabled:
             obs_trace.enable(cfg.obs.trace_cap)
             obs_trace.reset()
             registry().reset()
+            store = obs_ts.TimeSeriesStore(cfg.obs.ts_capacity)
+            obs_ts.set_active(store)
+            engine = obs_health.HealthEngine(
+                obs_health.default_rules(cfg), store,
+                registry=registry())
+            sampler = obs_ts.Sampler(
+                store, interval_s=cfg.obs.sample_interval_s,
+                after_sample=engine.evaluate_sample)
+            sampler.start()
         ticks = []
         train_net(cfg, prefix=os.path.join(workdir, f"model-{tag}", "e2e"),
                   end_epoch=1, seed=0,
@@ -227,6 +270,8 @@ def measure_overhead(workdir: str, num_images: int) -> dict:
                   step_callback=lambda step: ticks.append(
                       time.perf_counter()))
         if enabled:
+            sampler.stop(final_sample=False)
+            obs_ts.set_active(None)
             obs_trace.disable()
         deltas = np.diff(ticks)[4:]
         return float(np.median(deltas) * 1e3)
@@ -243,6 +288,7 @@ def measure_overhead(workdir: str, num_images: int) -> dict:
         "network": "tiny",
         "canvas": "128x160",
         "steps_per_arm": num_images - 4,
+        "sampling": "timeseries @ 0.25s + health rules (enabled arm)",
         "note": "median per-step wall over 1 epoch per arm, first 4 "
                 "steps (compiles) excluded; single contended CPU core — "
                 "treat small percentages as noise-bounded",

@@ -92,6 +92,10 @@ def main(argv=None):
         logger.info("warming %d bucket(s) at batch %d ...",
                     len(engine.buckets), cfg.serve.batch_size)
         engine.warmup()
+    if obs_sess is not None and obs_sess.flight is not None:
+        # a flight record from this process should carry the engine's
+        # queue/warmup state at dump time, not just its metrics
+        obs_sess.flight.add_context("engine", engine.healthz)
     names = args.class_names.split(",") if args.class_names else None
     srv = make_server(engine, args.host, args.port, class_names=names)
     host, port = srv.server_address[:2]

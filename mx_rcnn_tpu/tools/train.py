@@ -613,6 +613,18 @@ def main(argv=None):
     from mx_rcnn_tpu.obs.runrec import cli_obs
 
     obs_sess = cli_obs(cfg, "train")
+    if obs_sess is not None and obs_sess.flight is not None:
+        # a train-side flight record should carry where the loop was:
+        # the step/epoch gauges are already in the samples, but the
+        # registry view at dump time pins the exact last-published state
+        from mx_rcnn_tpu.obs.metrics import registry as _reg
+
+        obs_sess.flight.add_context(
+            "train", lambda: {"step": _reg().counter("train.steps"),
+                              "epochs_done": _reg().counter(
+                                  "train.epochs"),
+                              "samples_per_sec": _reg().gauge(
+                                  "train.samples_per_sec")})
     exit_code = 0
     try:
         if args.elastic or cfg.elastic.enabled:

@@ -1,0 +1,728 @@
+"""Fleet-wide time-series plane (ISSUE 14): store/sampler exactness,
+cross-process collection across replica churn, health-rule hysteresis,
+and the flight recorder's schema + trigger paths."""
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.obs import collect as obs_collect
+from mx_rcnn_tpu.obs import flightrec
+from mx_rcnn_tpu.obs import health as obs_health
+from mx_rcnn_tpu.obs import timeseries as obs_ts
+from mx_rcnn_tpu.obs.collect import (Collector, HttpSource,
+                                     RegistrySource, sources_from_urls,
+                                     view_to_snapshot)
+from mx_rcnn_tpu.obs.flightrec import FlightRecorder
+from mx_rcnn_tpu.obs.health import (CRITICAL, EXIT_BY_VERDICT, OK, WARN,
+                                    HealthEngine, Rule, default_rules)
+from mx_rcnn_tpu.obs.metrics import Registry, start_metrics_server
+from mx_rcnn_tpu.obs.timeseries import Sampler, TimeSeriesStore
+
+
+# ---------------------------------------------------------------------------
+# TimeSeriesStore: windowed queries on synthetic samples
+# ---------------------------------------------------------------------------
+
+
+def _fill(store, reg, points):
+    """points: list of (ts, mutate_fn) — mutate the registry, sample."""
+    for ts, fn in points:
+        fn(reg)
+        store.sample(reg, ts=ts)
+
+
+class TestStoreQueries:
+    def test_delta_rate_gauge_over_window(self):
+        reg, store = Registry(), TimeSeriesStore(capacity=16)
+        _fill(store, reg, [
+            (100.0, lambda r: (r.inc("c", 10), r.set_gauge("g", 5.0))),
+            (110.0, lambda r: (r.inc("c", 20), r.set_gauge("g", 3.0))),
+            (120.0, lambda r: (r.inc("c", 30), r.set_gauge("g", 9.0))),
+        ])
+        # full window: counter went 10 -> 60 over 20 s
+        assert store.delta("c") == 50.0
+        assert store.rate("c") == pytest.approx(2.5)
+        # trailing 10 s window cuts the first sample
+        assert store.delta("c", 10.0) == 30.0
+        assert store.rate("c", 10.0) == pytest.approx(3.0)
+        assert store.gauge("g") == 9.0
+        assert store.gauge_min("g") == 3.0
+        assert store.gauge_max("g", 10.0) == 9.0
+        assert store.gauge_min("g", 10.0) == 3.0
+        # absent names read None, not 0 (missing_ok rules depend on it)
+        assert store.delta("nope") is None
+        assert store.gauge("nope") is None
+        assert store.rate("c", 0.0) is None  # single-sample window
+
+    def test_series_and_ring_bound(self):
+        reg, store = Registry(), TimeSeriesStore(capacity=4)
+        for i in range(10):
+            reg.set_gauge("g", float(i))
+            store.sample(reg, ts=100.0 + i)
+        assert len(store) == 4
+        assert store.dropped == 6
+        assert store.series("g") == [(106.0, 6.0), (107.0, 7.0),
+                                     (108.0, 8.0), (109.0, 9.0)]
+
+    def test_windowed_percentile_exact(self):
+        """The windowed p99 must reflect ONLY the window's observations:
+        an old latency spike outside the window cannot poison it."""
+        reg, store = Registry(), TimeSeriesStore(capacity=16)
+        store.sample(reg, ts=100.0)
+        reg.observe("lat", 5000.0)  # old spike: lands between 100 and 125
+        store.sample(reg, ts=125.0)  # window edge: spike is cumulative
+        for v in (10.0, 12.0, 11.0, 13.0):
+            reg.observe("lat", v)
+        store.sample(reg, ts=130.0)
+        # full history (edges 100/130) sees the spike; the trailing 10 s
+        # window (edges 125/130 — the count DIFFERENCE) must not
+        assert store.pctl("lat", 99) >= 5000.0 * 0.9
+        p99_win = store.pctl("lat", 99, 10.0)
+        assert p99_win is not None and p99_win < 100.0
+        hw = store.hist_window("lat", 10.0)
+        assert hw["count"] == 4 and hw["windowed"] is True
+
+    def test_summary_only_fallback(self):
+        """Remote scrapes carry summaries, not bucket counts — pctl
+        degrades to the scraped value instead of failing."""
+        store = TimeSeriesStore(capacity=8)
+        snap = {"counters": {"c": 5}, "gauges": {},
+                "hists": {"lat": {"count": 3, "p50": 10.0, "p99": 40.0,
+                                  "max": 41.0}}}
+        store.append_snapshot(snap, ts=100.0)
+        store.append_snapshot(snap, ts=101.0)
+        assert store.pctl("lat", 99, 60.0) == 40.0
+        assert store.hist_window("lat", 60.0)["windowed"] is False
+
+    def test_scrape_section_shape(self):
+        reg, store = Registry(), TimeSeriesStore(capacity=8)
+        _fill(store, reg, [
+            (100.0, lambda r: (r.inc("c", 5), r.observe("lat", 10.0))),
+            (110.0, lambda r: (r.inc("c", 15), r.observe("lat", 20.0))),
+        ])
+        sec = store.scrape_section(window_s=60.0)
+        assert sec["samples"] == 2 and sec["dropped"] == 0
+        assert sec["span_s"] == 10.0
+        assert sec["rates_per_s"]["c"] == pytest.approx(1.5)
+        assert "lat" in sec["p99"]
+
+
+class TestSamplerExactness:
+    def test_concurrent_sampling_is_consistent_and_exact(self):
+        """Writers hammer the registry while the sampler rings it: every
+        sample must be internally consistent (hist total == bucket sum)
+        and the final sample must carry the EXACT totals."""
+        reg = Registry()
+        store = TimeSeriesStore(capacity=512)
+        sampler = Sampler(store, interval_s=0.002, reg=reg)
+        N, THREADS = 400, 4
+        barrier = threading.Barrier(THREADS + 1)
+
+        def writer(seed):
+            barrier.wait()
+            for i in range(N):
+                reg.inc("w.count")
+                reg.observe("w.lat", float((seed * N + i) % 97) + 1.0)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(THREADS)]
+        for t in threads:
+            t.start()
+        sampler.start()
+        barrier.wait()
+        # the writers can outrun the daemon's first wakeup on a fast box:
+        # drive extra ticks from this thread (same code path the daemon
+        # runs) so samples land WHILE the registry is being hammered
+        while any(t.is_alive() for t in threads):
+            sampler.tick()
+        for t in threads:
+            t.join()
+        sampler.stop(final_sample=True)
+
+        samples = store.window(None)
+        assert len(samples) >= 2
+        for smp in samples:
+            h = smp["hists"].get("w.lat")
+            if h is not None:
+                # the under-lock copy: a torn sample would break this
+                assert int(h["counts"].sum()) == h["total"]
+                assert h["total"] <= smp["counters"].get("w.count", 0) \
+                    + THREADS * N  # sanity bound
+        final = samples[-1]
+        assert final["counters"]["w.count"] == THREADS * N
+        assert final["hists"]["w.lat"]["total"] == THREADS * N
+        assert store.delta("w.count") == float(
+            THREADS * N - samples[0]["counters"].get("w.count", 0))
+
+    def test_after_sample_hook_failure_disables_not_kills(self):
+        reg = Registry()
+        store = TimeSeriesStore(capacity=8)
+        calls = []
+
+        def bad_hook(smp):
+            calls.append(1)
+            raise RuntimeError("boom")
+
+        sampler = Sampler(store, interval_s=1.0, reg=reg,
+                          after_sample=bad_hook)
+        sampler.tick()
+        sampler.tick()  # hook disabled after the first failure
+        assert len(calls) == 1
+        assert len(store) == 2
+
+    def test_active_store_registration(self):
+        store = TimeSeriesStore()
+        obs_ts.set_active(store)
+        try:
+            assert obs_ts.active() is store
+        finally:
+            obs_ts.set_active(None)
+        assert obs_ts.active() is None
+
+
+# ---------------------------------------------------------------------------
+# Collector: churn-tolerant cross-process merge
+# ---------------------------------------------------------------------------
+
+
+class TestCollector:
+    def test_merge_labels_and_agg(self):
+        r1, r2 = Registry(), Registry()
+        r1.inc("serve.served", 10)
+        r1.set_gauge("depth", 3.0)
+        r2.inc("serve.served", 7)
+        r2.set_gauge("depth", 5.0)
+        col = Collector([
+            RegistrySource("replica-0", r1, labels={"zone": "a"}),
+            RegistrySource("replica-1", r2),
+        ])
+        view = col.collect()
+        assert view["up"] == 2
+        assert view["sources"]["replica-0"]["labels"] == {
+            "source": "replica-0", "zone": "a"}
+        # counters SUM, gauges stay per-source
+        assert view["agg"]["counters"]["serve.served"] == 17
+        assert view["agg"]["gauges"]["depth"] == {
+            "replica-0": 3.0, "replica-1": 5.0}
+
+    def test_resolver_churn_eject_relaunch(self):
+        """The eject→relaunch lifecycle: resolver returns None (down),
+        then a NEW registry with a bumped generation — the collector
+        follows without rebuilding, and counters never double-count."""
+        state = {"reg": Registry(), "gen": 1}
+        state["reg"].inc("serve.served", 5)
+
+        def resolve():
+            if state["reg"] is None:
+                return None
+            return state["reg"], {"generation": state["gen"]}
+
+        col = Collector([RegistrySource("replica-0", resolve)])
+        v1 = col.collect()
+        assert v1["sources"]["replica-0"]["labels"]["generation"] == 1
+        assert v1["agg"]["counters"]["serve.served"] == 5
+
+        state["reg"] = None  # ejected: mid-relaunch
+        v2 = col.collect()
+        assert v2["sources"]["replica-0"] == {"up": False}
+        assert v2["up"] == 0
+        assert v2["agg"]["counters"] == {}  # down ≠ zero: absent
+
+        fresh = Registry()  # relaunched: new engine, new registry
+        fresh.inc("serve.served", 2)
+        state.update(reg=fresh, gen=2)
+        v3 = col.collect()
+        assert v3["sources"]["replica-0"]["labels"]["generation"] == 2
+        assert v3["agg"]["counters"]["serve.served"] == 2
+
+    def test_http_source_real_server_and_down(self):
+        reg = Registry()
+        reg.inc("elastic.steps", 4)
+        reg.set_gauge("elastic.generation", 2)
+        srv = start_metrics_server(reg, port=0)
+        try:
+            url = "%s:%d" % srv.server_address[:2]
+            col = Collector([HttpSource("elastic-0", url)])
+            view = col.collect()
+            src = view["sources"]["elastic-0"]
+            assert src["up"] and src["counters"]["elastic.steps"] == 4
+            assert src["labels"]["source"] == "elastic-0"
+        finally:
+            srv.shutdown()
+            srv.server_close()
+        # server gone: down, not an exception
+        view = col.collect()
+        assert view["sources"]["elastic-0"] == {"up": False}
+
+    def test_sources_from_urls_parsing(self):
+        out = sources_from_urls(
+            "127.0.0.1:9090, worker=http://h:1/metrics, train=9137,")
+        assert [s.name for s in out] == ["source-0", "worker", "train"]
+        assert out[0].url == "http://127.0.0.1:9090/metrics"
+        assert out[1].url == "http://h:1/metrics"
+        # a bare port (the documented `--url 9101` form) is this host
+        assert out[2].url == "http://127.0.0.1:9137/metrics"
+
+    def test_view_to_snapshot_semantics(self):
+        r1, r2 = Registry(), Registry()
+        r1.inc("served", 10)
+        r1.set_gauge("ready", 2.0)
+        r1.observe("lat", 10.0)
+        r2.inc("served", 5)
+        r2.set_gauge("ready", 1.0)
+        r2.observe("lat", 500.0)
+        col = Collector([RegistrySource("a", r1),
+                         RegistrySource("b", r2)])
+        snap = view_to_snapshot(col.collect())
+        assert snap["counters"]["served"] == 15          # fleet total
+        assert snap["gauges"]["ready"] == 1.0            # worst source
+        assert snap["gauges"]["ready@a"] == 2.0          # labeled copy
+        assert snap["gauges"]["ready@b"] == 1.0
+        lat = snap["hists"]["lat"]
+        assert lat["count"] == 2
+        assert lat["p99"] >= 500.0 * 0.9                 # worst tail
+
+    def test_collector_for_fleet_shapes(self):
+        """Duck-typed fleet: collector_for_fleet reads manager.replicas
+        via each replica's lock/engine/generation/state fields."""
+
+        class FakeMetrics:
+            def __init__(self, reg):
+                self.registry = reg
+
+        class FakeEngine:
+            def __init__(self, reg):
+                self.metrics = FakeMetrics(reg)
+
+        class FakeReplica:
+            def __init__(self, rid, reg):
+                self.id = rid
+                self._lock = threading.Lock()
+                self.engine = FakeEngine(reg)
+                self.generation = 3
+                self.state = "ready"
+
+        class FakeManager:
+            pass
+
+        class FakeRouter:
+            pass
+
+        reg = Registry()
+        reg.inc("serve.served", 9)
+        router_reg = Registry()
+        router_reg.set_gauge("fleet.replicas_ready", 1.0)
+        router = FakeRouter()
+        router.manager = FakeManager()
+        router.manager.replicas = [FakeReplica(0, reg)]
+        router.manager.registry = router_reg
+        col = obs_collect.collector_for_fleet(router)
+        view = col.collect()
+        assert view["sources"]["replica-0"]["labels"] == {
+            "source": "replica-0", "generation": 3, "state": "ready"}
+        assert view["sources"]["router"]["gauges"][
+            "fleet.replicas_ready"] == 1.0
+        # replica dies: resolver reads engine=None as down
+        router.manager.replicas[0].engine = None
+        assert col.collect()["sources"]["replica-0"] == {"up": False}
+
+
+# ---------------------------------------------------------------------------
+# HealthEngine: hysteresis, verdicts, publication
+# ---------------------------------------------------------------------------
+
+
+def _gauge_store(values, name="g"):
+    """A store whose gauge reads back each value in sequence per tick."""
+    reg, store = Registry(), TimeSeriesStore(capacity=64)
+    ts = 100.0
+    for v in values:
+        reg.set_gauge(name, v)
+        store.sample(reg, ts=ts)
+        ts += 1.0
+    return store
+
+
+class TestHealthEngine:
+    def test_single_bad_sample_does_not_flap(self):
+        """for_samples=2: one breaching evaluation must NOT change the
+        verdict (the hysteresis acceptance assertion)."""
+        reg, store = Registry(), TimeSeriesStore(capacity=64)
+        rule = Rule("hot", "g", "gauge", ">", 10.0, severity=WARN,
+                    for_samples=2, clear_samples=2)
+        eng = HealthEngine([rule], store)
+        ts = [100.0]
+
+        def feed(v):
+            reg.set_gauge("g", v)
+            store.sample(reg, ts=ts[0])
+            ts[0] += 1.0
+            return eng.evaluate()
+
+        assert feed(5.0)["verdict"] == OK
+        assert feed(99.0)["verdict"] == OK      # 1st breach: held
+        v = feed(99.0)                          # 2nd consecutive: fires
+        assert v["verdict"] == WARN and v["changed"]
+        assert feed(5.0)["verdict"] == WARN     # 1st clean: held
+        v = feed(5.0)                           # 2nd clean: clears
+        assert v["verdict"] == OK and v["changed"]
+
+    def test_breach_counter_resets_on_clean(self):
+        reg, store = Registry(), TimeSeriesStore(capacity=64)
+        rule = Rule("hot", "g", "gauge", ">", 10.0, for_samples=2)
+        eng = HealthEngine([rule], store)
+        ts = [100.0]
+
+        def feed(v):
+            reg.set_gauge("g", v)
+            store.sample(reg, ts=ts[0])
+            ts[0] += 1.0
+            return eng.evaluate()
+
+        # breach, clean, breach, clean... never 2 consecutive → never
+        # fires (a flapping metric stays OK)
+        for v in (99.0, 5.0, 99.0, 5.0, 99.0):
+            assert feed(v)["verdict"] == OK
+
+    def test_missing_metric_holds_state(self):
+        store = _gauge_store([])  # empty store: every query reads None
+        rule = Rule("r", "absent", "gauge", ">", 1.0, for_samples=1)
+        eng = HealthEngine([rule], store)
+        v = eng.evaluate()
+        assert v["verdict"] == OK
+        assert v["rules"][0]["value"] is None
+        assert v["rules"][0]["breaching"] is None
+
+    def test_severity_and_exit_codes(self):
+        store = _gauge_store([100.0, 100.0])
+        rules = [Rule("warny", "g", "gauge", ">", 10.0, severity=WARN,
+                      for_samples=1),
+                 Rule("crit", "g", "gauge", ">", 50.0, severity=CRITICAL,
+                      for_samples=1)]
+        eng = HealthEngine(rules, store)
+        v = eng.evaluate()
+        assert v["verdict"] == CRITICAL and v["code"] == 2
+        assert set(v["firing"]) == {"warny", "crit"}
+        assert eng.exit_code() == 2
+        assert EXIT_BY_VERDICT == {"OK": 0, "WARN": 1, "CRITICAL": 2}
+
+    def test_ratio_kind_and_rate_kind(self):
+        reg, store = Registry(), TimeSeriesStore(capacity=8)
+        _fill(store, reg, [
+            (100.0, lambda r: (r.inc("shed", 0), r.inc("sub", 100))),
+            (110.0, lambda r: (r.inc("shed", 20), r.inc("sub", 100))),
+        ])
+        ratio = Rule("shed-frac", "shed/sub", "ratio", ">", 0.05,
+                     for_samples=1)
+        assert ratio.value(store) == pytest.approx(0.2)
+        rate = Rule("rps", "sub", "rate", "<", 50.0, for_samples=1)
+        assert rate.value(store) == pytest.approx(10.0)
+
+    def test_publish_record_and_transition_callback(self):
+        reg, store = Registry(), TimeSeriesStore(capacity=8)
+        events, transitions = [], []
+
+        class FakeRecord:
+            def event(self, kind, **kw):
+                events.append((kind, kw))
+
+        eng = HealthEngine(
+            [Rule("crit", "g", "gauge", ">", 10.0, severity=CRITICAL,
+                  for_samples=1, clear_samples=1)],
+            store, registry=reg, record=FakeRecord(),
+            on_transition=lambda p, n, v: transitions.append((p, n)))
+        reg.set_gauge("g", 99.0)
+        store.sample(reg, ts=100.0)
+        eng.evaluate()
+        assert reg.gauge("health.verdict") == 2.0
+        assert reg.gauge("health.rule.crit") == 1.0
+        assert events == [("health_transition",
+                           {"prev": "OK", "verdict": "CRITICAL",
+                            "firing": ["crit"]})]
+        assert transitions == [("OK", "CRITICAL")]
+        # recovery publishes + notifies the other direction
+        reg.set_gauge("g", 1.0)
+        store.sample(reg, ts=101.0)
+        eng.evaluate()
+        assert reg.gauge("health.verdict") == 0.0
+        assert transitions[-1] == ("CRITICAL", "OK")
+
+    def test_default_rules_read_config(self):
+        cfg = generate_config("tiny", "synthetic",
+                              obs__health_window_s=45.0,
+                              fleet__replicas=3)
+        rules = {r.name: r for r in default_rules(cfg)}
+        assert rules["serve-p99-budget"].window_s == 45.0
+        assert rules["serve-p99-budget"].threshold == pytest.approx(
+            0.9 * cfg.serve.default_timeout_ms)
+        assert rules["fleet-degraded"].threshold == 3.0
+        assert rules["fleet-degraded"].severity == CRITICAL
+        # every rule is missing_ok: partial deployments stay judgeable
+        assert all(r.missing_ok for r in rules.values())
+
+    def test_fleet_degraded_fires_on_one_lost_replica(self):
+        """The kill-mid-burst acceptance: ready < configured is
+        CRITICAL immediately (the router masks, health must not)."""
+        cfg = generate_config("tiny", "synthetic", fleet__replicas=2)
+        reg, store = Registry(), TimeSeriesStore(capacity=8)
+        eng = HealthEngine(default_rules(cfg), store)
+        reg.set_gauge("fleet.replicas_ready", 2.0)
+        store.sample(reg, ts=100.0)
+        assert eng.evaluate()["verdict"] == OK
+        reg.set_gauge("fleet.replicas_ready", 1.0)
+        store.sample(reg, ts=101.0)
+        assert eng.evaluate()["verdict"] == CRITICAL
+        reg.set_gauge("fleet.replicas_ready", 2.0)
+        store.sample(reg, ts=102.0)
+        assert eng.evaluate()["verdict"] == OK
+
+    def test_active_engine_verdict_surface(self):
+        store = _gauge_store([99.0], name="g")
+        eng = HealthEngine([Rule("r", "g", "gauge", ">", 1.0,
+                                 for_samples=1)], store)
+        eng.evaluate()
+        obs_health.set_active_engine(eng)
+        try:
+            v = obs_health.active_verdict()
+            assert v["verdict"] == WARN
+        finally:
+            obs_health.set_active_engine(None)
+        assert obs_health.active_verdict() is None
+
+
+# ---------------------------------------------------------------------------
+# FlightRecorder: schema + triggers
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def flight_rig(tmp_path):
+    reg, store = Registry(), TimeSeriesStore(capacity=32)
+    reg.inc("serve.served", 5)
+    reg.observe("serve.total_ms", 12.0)
+    store.sample(reg, ts=100.0)
+    store.sample(reg, ts=101.0)
+    rec = FlightRecorder(store, str(tmp_path), window_s=60.0,
+                         min_gap_s=0.0)
+    return reg, store, rec, tmp_path
+
+
+class TestFlightRecorder:
+    def test_dump_schema_and_context(self, flight_rig):
+        reg, store, rec, tmp = flight_rig
+        rec.note_event({"event": "fleet_eject", "replica": 0})
+        rec.add_context("fleet", lambda: {"replicas": [
+            {"id": 0, "state": "ejected"}]})
+        rec.add_context("broken", lambda: 1 / 0)
+        path = rec.dump("manual", detail="test")
+        assert path and os.path.exists(path)
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["schema"] == "mx_rcnn_tpu.flight/1"
+        assert doc["reason"] == "manual"
+        assert doc["pid"] == os.getpid()
+        assert len(doc["samples"]) == 2
+        s = doc["samples"][-1]
+        assert s["counters"]["serve.served"] == 5
+        # ndarray bucket counts serialized as lists
+        assert isinstance(
+            s["hists"]["serve.total_ms"]["counts"], list)
+        assert doc["events"] == [{"event": "fleet_eject", "replica": 0}]
+        assert doc["context"]["fleet"]["replicas"][0]["id"] == 0
+        assert "error" in doc["context"]["broken"]  # fail-soft provider
+        assert doc["extra"]["detail"] == "test"
+        assert rec.dumps == [path]
+
+    def test_rate_limit_per_reason(self, tmp_path):
+        store = TimeSeriesStore(capacity=4)
+        rec = FlightRecorder(store, str(tmp_path), min_gap_s=3600.0)
+        p1 = rec.dump("watchdog")
+        assert p1 is not None
+        assert rec.dump("watchdog") is None          # rate-limited
+        assert rec.dump("crash") is not None         # distinct reason
+        assert rec.dump("watchdog", force=True) is not None
+
+    def test_excepthook_trigger_chains(self, flight_rig):
+        reg, store, rec, tmp = flight_rig
+        seen = []
+        prev = sys.excepthook
+        sys.excepthook = lambda *a: seen.append(a)
+        try:
+            rec.arm(signals=False, excepthook=True, watchdog=False)
+            try:
+                raise ValueError("boom")
+            except ValueError:
+                sys.excepthook(*sys.exc_info())
+        finally:
+            rec.disarm()
+            sys.excepthook = prev
+        assert len(seen) == 1                        # chained through
+        assert any("crash" in p for p in rec.dumps)
+        with open(rec.dumps[0]) as f:
+            doc = json.load(f)
+        assert "ValueError: boom" in doc["extra"]["error"]
+
+    def test_watchdog_trip_listener(self, flight_rig):
+        reg, store, rec, tmp = flight_rig
+        from mx_rcnn_tpu.analysis import sanitizer
+        rec.arm(signals=False, excepthook=False, watchdog=True)
+        try:
+            sanitizer._notify_trip({"kind": "stall", "held_ms": 31000})
+        finally:
+            rec.disarm()
+        assert any("watchdog" in p for p in rec.dumps)
+        with open(rec.dumps[0]) as f:
+            doc = json.load(f)
+        assert doc["events"][-1]["event"] == "watchdog_trip"
+        # disarm really unhooks: another trip dumps nothing new
+        n = len(rec.dumps)
+        sanitizer._notify_trip({"kind": "stall"})
+        assert len(rec.dumps) == n
+
+    def test_health_transition_trigger(self, flight_rig):
+        reg, store, rec, tmp = flight_rig
+        rec.on_health_transition("OK", "WARN", {"firing": ["w"]})
+        assert rec.dumps == []                       # WARN only rings
+        rec.on_health_transition("WARN", "CRITICAL",
+                                 {"firing": ["fleet-degraded"]})
+        assert len(rec.dumps) == 1
+        with open(rec.dumps[0]) as f:
+            doc = json.load(f)
+        assert doc["reason"] == "health-critical"
+        assert doc["extra"]["firing"] == ["fleet-degraded"]
+        events = [e["event"] for e in doc["events"]]
+        assert events == ["health_transition", "health_transition"]
+
+    def test_module_trigger_and_active(self, flight_rig):
+        reg, store, rec, tmp = flight_rig
+        assert flightrec.trigger("elastic-peer-failure") is None
+        flightrec.set_active(rec)
+        try:
+            path = flightrec.trigger("elastic-peer-failure", rank=1)
+            assert path is not None
+            with open(path) as f:
+                assert json.load(f)["extra"]["rank"] == 1
+        finally:
+            flightrec.set_active(None)
+
+    def test_runrec_listener_feeds_flight(self, tmp_path):
+        """RunRecord.add_listener → note_event: every runrec event lands
+        in the black box with zero emit-site instrumentation."""
+        from mx_rcnn_tpu.obs.runrec import RunRecord
+        store = TimeSeriesStore(capacity=4)
+        rr = RunRecord("t", base_dir=str(tmp_path))
+        rec = FlightRecorder(store, rr.dir, min_gap_s=0.0)
+        rr.add_listener(rec.note_event)
+        try:
+            rr.event("fleet_eject", replica=2, reason="engine-dead")
+        finally:
+            rr.close()
+        path = rec.dump("manual")
+        with open(path) as f:
+            doc = json.load(f)
+        ejects = [e for e in doc["events"]
+                  if e.get("event") == "fleet_eject"]
+        assert ejects and ejects[0]["replica"] == 2
+        # listener removal stops the feed
+        rec2 = FlightRecorder(store, rr.dir, min_gap_s=0.0)
+        rr.add_listener(rec2.note_event)
+        rr.remove_listener(rec2.note_event)
+
+
+# ---------------------------------------------------------------------------
+# CliObs wiring: config → live plane → teardown
+# ---------------------------------------------------------------------------
+
+
+class TestCliObsWiring:
+    def test_full_plane_build_and_teardown(self, tmp_path):
+        from mx_rcnn_tpu.obs.runrec import cli_obs
+        cfg = generate_config(
+            "tiny", "synthetic", obs__enabled=True,
+            obs__run_dir=str(tmp_path), obs__timeseries=True,
+            obs__sample_interval_s=0.05, obs__health=True,
+            obs__flight=True)
+        sess = cli_obs(cfg, "test")
+        try:
+            assert sess.store is not None and sess.sampler is not None
+            assert sess.health is not None and sess.flight is not None
+            assert obs_ts.active() is sess.store
+            assert obs_health.active_engine() is sess.health
+            assert flightrec.active() is sess.flight
+            # the sampler thread is really ringing the registry
+            import time as _time
+            deadline = _time.monotonic() + 5.0
+            while len(sess.store) < 2 and _time.monotonic() < deadline:
+                _time.sleep(0.05)
+            assert len(sess.store) >= 2
+        finally:
+            sess.close(metric="t", value=1, unit="x")
+        assert obs_ts.active() is None
+        assert obs_health.active_engine() is None
+        assert flightrec.active() is None
+
+    def test_off_by_default(self):
+        from mx_rcnn_tpu.obs.runrec import cli_obs
+        cfg = generate_config("tiny", "synthetic")
+        assert cli_obs(cfg, "test") is None
+        assert cfg.obs.timeseries is False
+        assert cfg.obs.health is False
+        assert cfg.obs.flight is False
+
+    def test_metrics_exporter_attaches_timeseries_and_health(self):
+        import urllib.request
+        reg = Registry()
+        reg.inc("c", 3)
+        store = TimeSeriesStore(capacity=8)
+        store.sample(reg, ts=100.0)
+        store.sample(reg, ts=101.0)
+        eng = HealthEngine([Rule("r", "g", "gauge", ">", 1.0,
+                                 for_samples=1)], store)
+        eng.evaluate()
+        srv = start_metrics_server(reg, port=0)
+        obs_ts.set_active(store)
+        obs_health.set_active_engine(eng)
+        try:
+            url = "http://%s:%d" % srv.server_address[:2]
+            with urllib.request.urlopen(url + "/metrics",
+                                        timeout=5) as r:
+                snap = json.loads(r.read())
+            assert snap["timeseries"]["samples"] == 2
+            with urllib.request.urlopen(url + "/healthz",
+                                        timeout=5) as r:
+                hz = json.loads(r.read())
+            assert hz["health"]["verdict"] == OK
+        finally:
+            obs_ts.set_active(None)
+            obs_health.set_active_engine(None)
+            srv.shutdown()
+            srv.server_close()
+
+    def test_healthz_503_on_critical(self):
+        import urllib.error
+        import urllib.request
+        reg = Registry()
+        store = _gauge_store([99.0])
+        eng = HealthEngine([Rule("r", "g", "gauge", ">", 1.0,
+                                 severity=CRITICAL, for_samples=1)],
+                           store)
+        eng.evaluate()
+        srv = start_metrics_server(reg, port=0)
+        obs_health.set_active_engine(eng)
+        try:
+            url = "http://%s:%d/healthz" % srv.server_address[:2]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url, timeout=5)
+            assert ei.value.code == 503
+            body = json.loads(ei.value.read())
+            assert body["health"]["verdict"] == CRITICAL
+        finally:
+            obs_health.set_active_engine(None)
+            srv.shutdown()
+            srv.server_close()
