@@ -16,7 +16,7 @@ NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 .PHONY: all native lint test test-all test-gate serve-smoke ft-smoke \
 	obs-smoke perf-smoke elastic-smoke data-smoke fleet-smoke \
 	quant-smoke threadlint-smoke bulk-smoke crashsim-smoke \
-	health-smoke clean
+	health-smoke crosshost-smoke clean
 
 all: native
 
@@ -153,6 +153,20 @@ bulk-smoke:
 	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.bulk \
 		--smoke --check
 
+# cross-host smoke (docs/SERVING.md "Cross-host tier"): the gate-scale
+# CROSSHOST_r15 protocol with every "host" a real agent SUBPROCESS on a
+# loopback port — a real tiny-model agent joins by pulling the export
+# store (one sha-verified transfer per file, 0 post-warm recompiles),
+# the binary prepared frame A/Bs against the base64-JSON control arm,
+# 1→2 stub hosts scale behind the cross-host router, one agent is
+# SIGKILLed mid-burst under the LIVE gauge-driven scheduler (0 lost,
+# reroutes inside the original deadline, capacity restored on the
+# survivor with no operator input), and the bulk plane re-pins
+# exactly-once/byte-identical resume across a 2-host leg.  ~2 min.
+crosshost-smoke:
+	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.loadgen \
+		--crosshost_smoke --check
+
 # crash-consistency smoke (docs/ANALYSIS.md "crashsim"): records the
 # three persistence planes' REAL commit workloads (snapshotter epoch/
 # interrupt/GC commits, export-store create→add→finish, bulk-sink
@@ -200,14 +214,15 @@ elastic-smoke:
 # then the perf-tooling smoke (~1 min), the observability smoke
 # (~1 min), the fleet-health smoke (health-smoke, ~30 s), the
 # streaming input-plane smoke (data-smoke, ~30 s), the
-# serving-fleet smoke (fleet-smoke, ~2 min), the bulk kill+resume
+# serving-fleet smoke (fleet-smoke, ~2 min), the cross-host fleet
+# smoke (crosshost-smoke, ~2 min), the bulk kill+resume
 # smoke (bulk-smoke, ~2 min), the 2-kill crash loop (ft-smoke,
 # ~2 min), the quantized-inference smoke (quant-smoke, ~2 min), the
 # elastic shrink/grow storm (elastic-smoke, ~3 min) and the
 # sanitizer-armed serve+elastic re-run (threadlint-smoke, ~4 min)
 test-gate: lint crashsim-smoke serve-smoke perf-smoke obs-smoke \
-		health-smoke data-smoke fleet-smoke bulk-smoke quant-smoke \
-		ft-smoke elastic-smoke threadlint-smoke
+		health-smoke data-smoke fleet-smoke crosshost-smoke bulk-smoke \
+		quant-smoke ft-smoke elastic-smoke threadlint-smoke
 	python -m pytest tests/ -x -q -m "gate"
 
 clean:

@@ -381,6 +381,78 @@ class FleetConfig:
 
 
 @dataclass(frozen=True)
+class CrosshostConfig:
+    """TPU addition (no reference equivalent — the reference is strictly
+    single-process): policy knobs for the cross-host serving plane
+    (``serve/remote.py`` + ``serve/agent.py`` + ``serve/scheduler.py``,
+    docs/SERVING.md "Cross-host tier") — per-host replica agents behind
+    the fleet's ``Replica`` seam, dispatched over persistent keep-alive
+    HTTP with a binary prepared-path wire format, an export-store
+    distribution plane (one sha-verified resumable pull per joining
+    host), and a gauge-driven scheduler that adds/drains replicas
+    against traffic and re-places capacity when a host dies.
+
+    Same 3-level precedence as every section (hardcoded defaults <
+    presets < ``--set crosshost__field=value`` CLI overrides).
+    """
+
+    # comma-separated agent base URLs ("host:port,host:port" or
+    # "name=url"); non-empty = tools/fleet.py serve builds a cross-host
+    # router of RemoteReplicas instead of in-process engines
+    agents: str = ""
+    # persistent keep-alive HTTP connections per remote replica — each
+    # one an independent request pipeline to the agent, so a remote
+    # replica serves up to connections x pipeline_depth frames in flight
+    connections: int = 2
+    # in-flight frames admitted per connection (the bounded pipeline:
+    # the frame that would exceed connections x pipeline_depth sheds at
+    # the head instead of queueing unboundedly toward a slow host)
+    pipeline_depth: int = 4
+    # socket-level I/O timeout for agent RPCs — a transport backstop
+    # strictly above any request deadline (deadlines are enforced by the
+    # agent's own admission path; this catches dead-host half-opens)
+    io_timeout_s: float = 60.0
+    # backlog-feed scrape cadence: the head polls each agent's /metrics
+    # this often for bucket-lane depths (the JSQ routing signal) and
+    # fleet gauges (the scheduler signal)
+    scrape_interval_s: float = 0.25
+    # consecutive transport/scrape failures before a remote replica
+    # reads not-alive and the manager ejects it (single blips — one lost
+    # frame, one slow scrape — must not eject a healthy host)
+    dead_after_failures: int = 3
+    # export-store distribution endpoint ("" = agents expect a local
+    # fleet.export_dir already in place).  Set to the head's StoreServer
+    # URL: a joining agent pulls the store ONCE (sha-verified,
+    # resumable), then every local replica export-warms from disk.
+    store_url: str = ""
+    # replica engines each agent starts locally
+    agent_replicas: int = 1
+    # --- scheduler (serve/scheduler.py) ----------------------------------
+    # fleet-wide ready-replica target (0 = adopt hosts x agent_replicas
+    # at scheduler start); the host-death re-place signal: ready < target
+    target_replicas: int = 0
+    min_replicas: int = 1            # never drain below
+    max_replicas: int = 8            # never add above
+    # scale-up triggers, judged over window_s: shed ratio
+    # (delta shed / delta submitted) above this...
+    up_shed_ratio: float = 0.05
+    # ...or mean bucket-lane backlog per ready replica above this many
+    # images
+    up_backlog: float = 2.0
+    # hysteresis (the obs/health.py idiom): a trigger must hold for this
+    # many consecutive decide() ticks to act...
+    for_samples: int = 2
+    # ...and the fleet must be fully idle (no backlog, no shed, ready >
+    # min) for this many consecutive ticks before a drain
+    idle_samples: int = 8
+    # post-action cooldown: no further add/drain until the last action
+    # is this old (lets the fleet absorb the resize before re-judging)
+    cooldown_s: float = 5.0
+    interval_s: float = 0.5          # scheduler tick cadence
+    window_s: float = 10.0           # rate/ratio judgment window
+
+
+@dataclass(frozen=True)
 class BulkConfig:
     """TPU addition (no reference equivalent — the reference scores a
     corpus through a synchronous single-GPU eval loop): policy knobs for
@@ -625,6 +697,7 @@ class Config:
     data: DataConfig = field(default_factory=DataConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    crosshost: CrosshostConfig = field(default_factory=CrosshostConfig)
     bulk: BulkConfig = field(default_factory=BulkConfig)
     ft: FTConfig = field(default_factory=FTConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)

@@ -97,10 +97,24 @@ class RegistrySource:
 
 class HttpSource:
     """A remote ``/metrics`` JSON endpoint (the stdlib exporter's or the
-    serve front end's response body is ``Registry.snapshot`` shaped)."""
+    serve front end's response body is ``Registry.snapshot`` shaped).
+
+    Every request carries a hard per-request timeout, and consecutive
+    failures open an exponential backoff window during which
+    :meth:`scrape` reports down WITHOUT touching the socket.  Together
+    they bound what one wedged endpoint can cost the collection loop: a
+    host that accepts connections but never answers (half-open after a
+    SIGKILL, a hung agent) stalls ONE scrape for ``timeout_s``, then
+    costs nothing until its backoff expires — it cannot turn every
+    sampler tick into a fleet-wide ``timeout_s`` stall while the other
+    sources' data ages (tests/test_remote.py pins this with a
+    deliberately hung server).
+    """
 
     def __init__(self, name: str, url: str, timeout_s: float = 2.0,
-                 labels: Optional[Dict] = None):
+                 labels: Optional[Dict] = None,
+                 backoff_base_s: float = 1.0,
+                 backoff_cap_s: float = 30.0):
         self.name = name
         if url.isdigit():  # bare port ("9101") = this host's exporter
             url = f"127.0.0.1:{url}"
@@ -108,17 +122,38 @@ class HttpSource:
         if not self.url.rstrip("/").endswith("/metrics"):
             self.url = self.url.rstrip("/") + "/metrics"
         self.timeout_s = float(timeout_s)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
         self._static_labels = dict(labels or {})
+        self._lock = threading.Lock()
+        self._failures = 0           # consecutive, reset on success
+        self._skip_until = 0.0       # monotonic deadline of the window
+
+    def failures(self) -> int:
+        with self._lock:
+            return self._failures
 
     def scrape(self) -> ScrapeResult:
+        with self._lock:
+            if time.monotonic() < self._skip_until:
+                return None  # backing off: down, and no socket touched
         try:
             with urllib.request.urlopen(self.url,
                                         timeout=self.timeout_s) as r:
                 snap = json.loads(r.read().decode())
         except Exception as e:  # connection refused / timeout / bad JSON
+            with self._lock:
+                self._failures += 1
+                delay = min(self.backoff_cap_s,
+                            self.backoff_base_s
+                            * (2.0 ** (self._failures - 1)))
+                self._skip_until = time.monotonic() + delay
             logger.debug("obs collect: source %s (%s) down: %s",
                          self.name, self.url, e)
             return None
+        with self._lock:
+            self._failures = 0
+            self._skip_until = 0.0
         if not isinstance(snap, dict):
             return None
         # the serve front end nests the registry under "registry";

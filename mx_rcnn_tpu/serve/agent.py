@@ -1,0 +1,496 @@
+"""Per-host replica agent + export-store distribution plane.
+
+No reference equivalent.  The agent is the host-side half of the
+cross-host fleet (``serve/remote.py`` is the head side): one process
+per host that
+
+* **joins the fleet by pulling the export store ONCE** —
+  :func:`pull_store` is sha-verified and resumable (Range requests
+  against :func:`make_store_server`; a truncated transfer resumes
+  where it died, a corrupt file is refused and re-pulled whole), and
+  the store lands on local disk so every local replica export-warms
+  from it: a joining host pays one transfer + N x the measured 0.37 s
+  warm, never N checkpoint pulls (ROADMAP item 2's store-placement
+  requirement);
+* runs ``crosshost.agent_replicas`` local replicas behind the standard
+  :class:`~mx_rcnn_tpu.serve.fleet.ReplicaManager` — ejects and
+  relaunches under the PR-6 RestartPolicy exactly like the single-host
+  fleet;
+* exposes the operational surface the head consumes: ``/healthz``
+  (join stats + local fleet state), ``/metrics`` (the PR-14 merged
+  local-fleet view, with per-bucket ``lane.<h>x<w>.depth`` gauges —
+  the head router's cross-host JSQ signal), ``/detect`` (JSON raw
+  image), the binary ``/prepared`` hot path, and ``POST /replicas``
+  (the scheduler's add/drain lever).
+
+The HTTP front end is deliberately the ``serve/server.py`` idiom:
+HTTP/1.1 + Content-Length on every reply, so the head's keep-alive
+connection pool reuses sockets for the life of the burst.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import logging
+import os
+import shutil
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import quote, unquote, urlsplit
+
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.obs.metrics import LoweringCounter, Registry
+from mx_rcnn_tpu.serve.export import MANIFEST_NAME
+from mx_rcnn_tpu.serve.queue import (DeadlineExceeded, RequestFailed,
+                                     ShedError)
+from mx_rcnn_tpu.serve.remote import (decode_prepared, encode_result,
+                                      normalize_agent_url)
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+FRAME_CTYPE = "application/x-mxrcnn-frame"
+
+
+# ---------------------------------------------------------------------------
+# store distribution: server
+# ---------------------------------------------------------------------------
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def store_index(root: str) -> Dict[str, Dict]:
+    """{relpath: {bytes, sha256}} over every committed file in an
+    export store (staging suffixes excluded — they are not part of the
+    store)."""
+    out: Dict[str, Dict] = {}
+    for dirpath, _dirs, files in os.walk(root):
+        for name in files:
+            if name.endswith((".tmp", ".part")):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, root)
+            out[rel] = {"bytes": os.path.getsize(path),
+                        "sha256": _sha256_file(path)}
+    return out
+
+
+class _StoreHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):  # quiet: the bench drives many requests
+        pass
+
+    def _reply_json(self, status: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (stdlib handler naming)
+        srv = self.server
+        if self.path == "/index":
+            self._reply_json(200, {"files": srv.index,
+                                   "root": srv.root})
+            return
+        if not self.path.startswith("/f/"):
+            self._reply_json(404, {"error": f"no route {self.path}"})
+            return
+        rel = unquote(self.path[len("/f/"):])
+        if rel not in srv.index:  # also rejects traversal: index is flat
+            self._reply_json(404, {"error": f"not in store: {rel}"})
+            return
+        path = os.path.join(srv.root, rel)
+        size = srv.index[rel]["bytes"]
+        start = 0
+        rng = self.headers.get("Range", "")
+        if rng.startswith("bytes=") and rng.endswith("-"):
+            try:
+                start = min(int(rng[len("bytes="):-1]), size)
+            except ValueError:
+                start = 0
+        with srv.stats_lock:
+            srv.requests.append({"rel": rel, "start": start})
+        n = size - start
+        self.send_response(206 if start else 200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(n))
+        if start:
+            self.send_header("Content-Range",
+                             f"bytes {start}-{size - 1}/{size}")
+        self.end_headers()
+        with open(path, "rb") as f:
+            f.seek(start)
+            shutil.copyfileobj(f, self.wfile)
+
+
+def make_store_server(root: str, host: str = "127.0.0.1",
+                      port: int = 0) -> ThreadingHTTPServer:
+    """Serve a (frozen) export store for host joins.  The sha index is
+    computed once at construction — the store is immutable after
+    ``ExportStore.finish`` by the admission discipline, so per-request
+    hashing would buy nothing.  ``server.requests`` records every file
+    request (the bench's one-transfer-per-host assertion reads it)."""
+    srv = ThreadingHTTPServer((host, port), _StoreHandler)
+    srv.daemon_threads = True
+    srv.root = root
+    srv.index = store_index(root)
+    srv.stats_lock = threading.Lock()
+    srv.requests: List[Dict] = []
+    return srv
+
+
+# ---------------------------------------------------------------------------
+# store distribution: pull client
+# ---------------------------------------------------------------------------
+
+class StorePullError(RuntimeError):
+    """A pulled file failed sha verification twice (resume + whole-file
+    re-pull) — the store copy is bad and warming from it would be
+    admission-refused anyway; fail the join loudly."""
+
+
+def pull_store(url: str, dest: str, timeout_s: float = 30.0) -> Dict:
+    """Mirror a remote export store into ``dest``: sha-verified,
+    resumable, idempotent.
+
+    * files already present with a matching sha are skipped (a host
+      re-join after an agent restart pays zero transfer);
+    * a leftover ``.part`` staging file resumes with a Range request
+      from its current length — the truncated bytes are never
+      re-shipped;
+    * every completed file is sha-verified BEFORE promotion; a mismatch
+      deletes the staging file and re-pulls whole, a second mismatch
+      raises :class:`StorePullError`;
+    * ``manifest.json`` is pulled LAST — the store-commit discipline
+      (manifest = commit point) holds across the wire, so a crash
+      mid-pull leaves a store the admission check refuses rather than
+      a manifest naming files that never arrived;
+    * promotion is fsync → rename → dir-fsync, the tree-wide durable
+      write idiom (a host crash after a reported join cannot tear the
+      store).
+    """
+    base = normalize_agent_url(url)
+    with urllib.request.urlopen(base + "/index", timeout=timeout_s) as r:
+        index = json.loads(r.read().decode())
+    files = index["files"]
+    names = sorted(n for n in files
+                   if os.path.basename(n) != MANIFEST_NAME)
+    names += sorted(n for n in files
+                    if os.path.basename(n) == MANIFEST_NAME)
+    stats = {"files": 0, "bytes": 0, "skipped": 0, "resumed": 0,
+             "refused": 0}
+    t0 = time.perf_counter()
+    for rel in names:
+        want = files[rel]
+        final = os.path.join(dest, rel)
+        if (os.path.exists(final)
+                and _sha256_file(final) == want["sha256"]):
+            stats["skipped"] += 1
+            continue
+        d = os.path.dirname(final)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        part = final + ".part"
+        for attempt in (0, 1):
+            start = (os.path.getsize(part) if os.path.exists(part)
+                     else 0)
+            if start > want["bytes"]:
+                os.unlink(part)  # longer than truth: unusable staging
+                start = 0
+            if start:
+                stats["resumed"] += 1
+            req = urllib.request.Request(base + "/f/" + quote(rel))
+            if start:
+                req.add_header("Range", f"bytes={start}-")
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                # a 200 despite our Range means the server restarted
+                # the file — restart the staging write with it
+                mode = "ab" if (start and r.status == 206) else "wb"
+                with open(part, mode) as f:
+                    shutil.copyfileobj(r, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+            if _sha256_file(part) == want["sha256"]:
+                os.replace(part, final)
+                dir_fd = os.open(d or ".", os.O_RDONLY)
+                try:
+                    os.fsync(dir_fd)
+                finally:
+                    os.close(dir_fd)
+                stats["files"] += 1
+                stats["bytes"] += int(want["bytes"])
+                break
+            stats["refused"] += 1
+            os.unlink(part)
+            if attempt == 1:
+                raise StorePullError(
+                    f"{rel}: sha mismatch after whole-file re-pull "
+                    f"(want {want['sha256'][:12]}…)")
+    stats["transfer_s"] = round(time.perf_counter() - t0, 3)
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# the agent
+# ---------------------------------------------------------------------------
+
+class ReplicaAgent:
+    """One per-host serving agent: local fleet + join/operate surface.
+
+    ``cfg.crosshost.store_url`` non-empty makes construction pull the
+    export store into ``cfg.fleet.export_dir`` first (the one-transfer
+    join); replicas then build through the standard
+    :func:`~mx_rcnn_tpu.serve.fleet.build_fleet` with that export root.
+    ``run_fn_factory`` keeps the bench/test stub seam.
+    """
+
+    def __init__(self, cfg: Config, model=None, variables=None, *,
+                 run_fn_factory=None, registry: Registry = None,
+                 record=None, class_names: List[str] = None):
+        from mx_rcnn_tpu.serve.fleet import build_fleet
+
+        cfg = cfg.replace_in("fleet",
+                             replicas=max(1, cfg.crosshost.agent_replicas))
+        self.cfg = cfg
+        self.class_names = class_names
+        self.registry = registry if registry is not None else Registry()
+        self.store_pull: Optional[Dict] = None
+        export_root = cfg.fleet.export_dir or None
+        if cfg.crosshost.store_url:
+            if not export_root:
+                raise ValueError("crosshost.store_url needs "
+                                 "fleet.export_dir as the local "
+                                 "placement target")
+            self.store_pull = pull_store(cfg.crosshost.store_url,
+                                         export_root)
+            logger.info("agent store pull: %s", self.store_pull)
+        t0 = time.perf_counter()
+        self.router = build_fleet(
+            cfg, model, variables,
+            export_root=export_root if run_fn_factory is None else None,
+            run_fn_factory=run_fn_factory,
+            registry=self.registry, record=record)
+        self.manager = self.router.manager
+        self.warm_s = round(time.perf_counter() - t0, 3)
+        # recompile watch: lowerings AFTER this point are post-warm —
+        # the join-cost acceptance reads the gauge this publishes
+        self._lowerings = LoweringCounter().__enter__()
+
+    # -- surfaces ----------------------------------------------------------
+
+    def healthz(self) -> Dict:
+        h = self.router.healthz()
+        h.update({
+            "agent": True,
+            "warm_s": self.warm_s,
+            "store_pull": self.store_pull,
+            "export_root": self.cfg.fleet.export_dir or None,
+            "programs": sum(r.describe().get("programs") or 0
+                            for r in list(self.manager.replicas)),
+        })
+        return h
+
+    def metrics_snapshot(self) -> Dict:
+        """The merged local-fleet view as one Registry.snapshot —
+        what the head's backlog feed scrapes.  Lane-depth and
+        liveness gauges are refreshed into the agent registry first,
+        so every scrape carries current routing/scheduling signals."""
+        from mx_rcnn_tpu.obs.collect import (collector_for_fleet,
+                                             view_to_snapshot)
+
+        ready = self.manager.ready_replicas()
+        for b in self.cfg.bucket.shapes:
+            depth = 0
+            for r in ready:
+                with r._lock:
+                    eng = r.engine
+                if eng is not None:
+                    depth += eng.bucket_depth(tuple(b))
+            self.registry.set_gauge(f"lane.{b[0]}x{b[1]}.depth", depth)
+        self.registry.set_gauge("agent.replicas_ready", len(ready))
+        self.registry.set_gauge("agent.lowered_after_warm",
+                                self._lowerings.n)
+        self.manager.export_gauges()
+        return view_to_snapshot(collector_for_fleet(self.router).collect())
+
+    def resize(self, target: int = None, delta: int = None) -> Dict:
+        """The scheduler lever: set (or nudge) the local replica count.
+        Adds launch asynchronously (the reply races the warmup —
+        ``fleet.replicas_ready`` catching up IS the signal the
+        scheduler watches); drains are synchronous and graceful."""
+        cur = len(self.manager.replicas)
+        want = cur + int(delta or 0) if target is None else int(target)
+        want = max(1, want)
+        added, drained = 0, 0
+        while len(self.manager.replicas) < want:
+            self.manager.add_replica()
+            added += 1
+        while len(self.manager.replicas) > want:
+            if self.manager.drain_replica() is None:
+                break
+            drained += 1
+        return {"replicas": len(self.manager.replicas),
+                "ready": len(self.manager.ready_replicas()),
+                "added": added, "drained": drained}
+
+    def close(self, timeout: float = 10.0) -> None:
+        self.router.close(timeout)
+
+
+# ---------------------------------------------------------------------------
+# the agent HTTP front end
+# ---------------------------------------------------------------------------
+
+class _AgentHandler(BaseHTTPRequestHandler):
+    # the server carries .agent / .connections (see make_agent_server)
+    protocol_version = "HTTP/1.1"
+
+    def setup(self):
+        super().setup()
+        with self.server.stats_lock:
+            self.server.connections += 1
+
+    def log_message(self, *a):
+        pass
+
+    def _reply_json(self, status: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_frame(self, body: bytes) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", FRAME_CTYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n)
+
+    def _wait_and_reply(self, req, timeout_ms: float, binary: bool,
+                        raw_dets: bool = False) -> None:
+        """Block the handler thread on the request handle and map its
+        terminal state to the serve/server.py status contract (429
+        shed / 504 expired / 500 failed)."""
+        budget = (timeout_ms / 1000.0 + 10.0) if timeout_ms else 60.0
+        try:
+            dets = req.wait(timeout=budget)
+        except ShedError:
+            self._reply_json(429, {"error": "shed"})
+            return
+        except DeadlineExceeded:
+            self._reply_json(504, {"error": "deadline expired"})
+            return
+        except (RequestFailed, TimeoutError) as e:
+            self._reply_json(500, {"error": str(e)})
+            return
+        if binary:
+            self._reply_frame(encode_result(dets))
+        elif raw_dets:
+            self._reply_json(200, {"dets_b64": {
+                int(c): base64.b64encode(
+                    np.ascontiguousarray(a, np.float32).tobytes()).decode()
+                for c, a in dets.items()}})
+        else:
+            from mx_rcnn_tpu.serve.server import detections_to_json
+
+            self._reply_json(200, {"detections": detections_to_json(
+                dets, self.server.agent.class_names)})
+
+    def do_GET(self):  # noqa: N802
+        agent = self.server.agent
+        try:
+            if self.path == "/healthz":
+                h = agent.healthz()
+                self._reply_json(200 if h.get("ok") else 503, h)
+            elif self.path == "/metrics":
+                self._reply_json(200, {"registry":
+                                       agent.metrics_snapshot()})
+            else:
+                self._reply_json(404, {"error": f"no route {self.path}"})
+        except Exception as e:
+            logger.exception("agent GET %s failed", self.path)
+            self._reply_json(500, {"error": str(e)})
+
+    def do_POST(self):  # noqa: N802
+        agent = self.server.agent
+        try:
+            if self.path == "/prepared":
+                buf = self._read_body()
+                try:
+                    data, im_info, timeout_ms = decode_prepared(buf)
+                except ValueError as e:
+                    self._reply_json(400, {"error": str(e)})
+                    return
+                req = agent.router.submit_prepared(
+                    data, im_info, data.shape[:2], timeout_ms=timeout_ms)
+                self._wait_and_reply(req, timeout_ms, binary=True)
+            elif self.path == "/prepared_json":
+                body = json.loads(self._read_body().decode())
+                shape = tuple(body["shape"])
+                data = np.frombuffer(
+                    base64.b64decode(body["data_b64"]),
+                    np.float32).reshape(shape)
+                timeout_ms = float(body.get("timeout_ms") or 0.0)
+                req = agent.router.submit_prepared(
+                    data, np.asarray(body["im_info"], np.float32),
+                    shape[:2], timeout_ms=timeout_ms)
+                self._wait_and_reply(req, timeout_ms, binary=False,
+                                     raw_dets=True)
+            elif self.path == "/detect":
+                from mx_rcnn_tpu.serve.server import decode_image_payload
+
+                body = json.loads(self._read_body().decode())
+                img = decode_image_payload(body)
+                timeout_ms = float(body.get("timeout_ms") or 0.0)
+                req = agent.router.submit(img, timeout_ms=timeout_ms)
+                self._wait_and_reply(req, timeout_ms, binary=False,
+                                     raw_dets=bool(body.get("raw_dets")))
+            elif self.path == "/replicas":
+                body = json.loads(self._read_body().decode() or "{}")
+                self._reply_json(200, agent.resize(
+                    target=body.get("target"), delta=body.get("delta")))
+            else:
+                self._reply_json(404, {"error": f"no route {self.path}"})
+        except ValueError as e:
+            self._reply_json(400, {"error": str(e)})
+        except Exception as e:
+            logger.exception("agent POST %s failed", self.path)
+            self._reply_json(500, {"error": str(e)})
+
+
+def make_agent_server(agent: ReplicaAgent, host: str = "127.0.0.1",
+                      port: int = 0) -> ThreadingHTTPServer:
+    """Bind the agent's HTTP front end (port 0 picks a free port —
+    read ``server.server_address``).  ``server.connections`` counts
+    accepted sockets: with HTTP/1.1 keep-alive the head's pool should
+    hold it at its connection count for a whole burst (pinned by
+    tests/test_remote.py)."""
+    srv = ThreadingHTTPServer((host, port), _AgentHandler)
+    srv.daemon_threads = True
+    srv.agent = agent
+    srv.stats_lock = threading.Lock()
+    srv.connections = 0
+    return srv

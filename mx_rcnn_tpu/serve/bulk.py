@@ -212,15 +212,24 @@ def detections_line(index: int, dets: Dict[int, np.ndarray]) -> str:
                       sort_keys=True, separators=(",", ":"))
 
 
-def auto_inflight(cfg: Config) -> int:
+def auto_inflight(cfg: Config, total_replicas: int = None) -> int:
     """The backpressure bound: ``bulk.max_inflight``, or (when 0)
     2 full micro-batches per replica, clamped under the per-lane shed
     watermark so steady-state single-bucket bulk traffic never sheds
-    even when JSQ lands every image on one replica's lane."""
+    even when JSQ lands every image on one replica's lane.
+
+    ``total_replicas`` overrides ``cfg.fleet.replicas`` for topologies
+    where the two differ: a cross-host router (``serve/remote.py``)
+    manages one RemoteReplica PER AGENT, each fronting
+    ``crosshost.agent_replicas`` real replicas — sizing in-flight off
+    the head's replica count alone would starve every agent's local
+    batcher below one full micro-batch per replica."""
     n = cfg.bulk.max_inflight
     if n > 0:
         return n
-    n = 2 * cfg.serve.batch_size * max(cfg.fleet.replicas, 1)
+    reps = (total_replicas if total_replicas and total_replicas > 0
+            else cfg.fleet.replicas)
+    n = 2 * cfg.serve.batch_size * max(reps, 1)
     return max(min(n, cfg.serve.shed_watermark - 1), 1)
 
 
@@ -237,7 +246,7 @@ class BulkRunner:
     def __init__(self, router, loader, sink: BulkSink, cfg: Config,
                  registry=None,
                  fault: Optional[Callable[[int], None]] = None,
-                 record=None):
+                 record=None, total_replicas: int = None):
         self.router = router
         self.loader = loader
         self.sink = sink
@@ -250,7 +259,10 @@ class BulkRunner:
         self.fault = fault
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._inflight = threading.BoundedSemaphore(auto_inflight(cfg))
+        # total_replicas: cross-host runs pass agents x agent_replicas
+        # (the head's own replica count undercounts the fleet)
+        self._inflight_bound = auto_inflight(cfg, total_replicas)
+        self._inflight = threading.BoundedSemaphore(self._inflight_bound)
         # per-batch result slots, keyed by PLAN batch index:
         # {bi: [line_or_None] * rows}; a batch leaves the dict when its
         # shard commits, so memory holds at most ~shard_batches batches
@@ -455,7 +467,7 @@ class BulkRunner:
                     if self.rec is not None:  # once per batch, not row
                         self.rec.set_gauge(
                             "bulk.inflight",
-                            auto_inflight(cfg) - self._inflight._value)
+                            self._inflight_bound - self._inflight._value)
                     for j, corpus_i in enumerate(indices):
                         while not self._inflight.acquire(timeout=1.0):
                             if self._error is not None:

@@ -209,13 +209,22 @@ class ReplicaManager:
     """
 
     def __init__(self, build_fn: Callable[[int], Tuple[ServingEngine, Dict]],
-                 cfg: Config, registry: Registry = None, record=None):
+                 cfg: Config, registry: Registry = None, record=None,
+                 replica_cls: type = None):
         if cfg.fleet.replicas < 1:
             raise ValueError(
                 f"fleet.replicas must be >= 1, got {cfg.fleet.replicas}")
         self.cfg = cfg
-        self.replicas = [Replica(i, build_fn)
+        # replica_cls: the cross-host plane manages RemoteReplica
+        # (serve/remote.py) through this same lifecycle
+        self._replica_cls = replica_cls or Replica
+        self._build_fn = build_fn
+        self.replicas = [self._replica_cls(i, build_fn)
                          for i in range(cfg.fleet.replicas)]
+        # resize surface (serve/scheduler.py → agent /replicas): list
+        # mutations only under this lock; readers iterate snapshots
+        self._resize_lock = threading.Lock()
+        self._next_rid = cfg.fleet.replicas
         self.registry = registry or process_registry()
         # optional RunRecord (obs/runrec.py): eject/rejoin land in
         # runs/<id>/events.jsonl — and through the record's listener
@@ -253,7 +262,7 @@ class ReplicaManager:
         self._stop.set()
         if self._monitor is not None:
             self._monitor.join(timeout)
-        for r in self.replicas:
+        for r in list(self.replicas):
             with r._lock:
                 r.closed = True
                 eng, r.engine, r.state = r.engine, None, R_DEAD
@@ -265,7 +274,70 @@ class ReplicaManager:
     # ------------------------------------------------------------------
 
     def ready_replicas(self) -> List[Replica]:
-        return [r for r in self.replicas if r.ready()]
+        return [r for r in list(self.replicas) if r.ready()]
+
+    # ------------------------------------------------------------------
+    # resize (the scheduler's add/drain surface — serve/scheduler.py
+    # drives it through the agent's POST /replicas)
+    # ------------------------------------------------------------------
+
+    def add_replica(self) -> Replica:
+        """Grow the set by one replica (fresh id — ids are never
+        reused, so per-replica gauges and flight records stay
+        unambiguous).  The launch runs on its own thread: the caller
+        (an HTTP control handler) must not block for a multi-second
+        warmup; a boot failure lands in the standard RestartPolicy
+        relaunch schedule."""
+        with self._resize_lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            r = self._replica_cls(rid, self._build_fn)
+            self.replicas.append(r)
+        if self.record is not None:
+            self.record.event("fleet_scale", action="add", replica=rid)
+
+        def boot():
+            if not r.launch():
+                self._schedule_relaunch(r, ("boot-failed",),
+                                        made_progress=False)
+
+        threading.Thread(target=boot, name=f"fleet-add-{rid}",
+                         daemon=True).start()
+        return r
+
+    def drain_replica(self, rid: int = None) -> Optional[int]:
+        """Shrink the set by one replica: remove it from routing, then
+        drain-close its engine (queued work finishes serving — a drain
+        is graceful by definition; abrupt death is ``eject``'s job).
+        Default victim: the highest-id ready replica.  Refuses to drain
+        the last replica (a fleet of zero serves nothing and can never
+        recover without an external add).  Returns the drained id, or
+        None if nothing was eligible."""
+        with self._resize_lock:
+            if len(self.replicas) <= 1:
+                return None
+            if rid is None:
+                cands = [r for r in self.replicas if r.ready()]
+                if not cands:
+                    return None
+                r = max(cands, key=lambda x: x.id)
+            else:
+                matches = [x for x in self.replicas if x.id == rid]
+                if not matches:
+                    return None
+                r = matches[0]
+            self.replicas.remove(r)
+        with r._lock:
+            r.closed = True
+            eng, r.engine, r.state = r.engine, None, R_DEAD
+        if eng is not None:
+            eng.close()
+        # the per-replica gauges would otherwise freeze at their last
+        # value and read as a live replica forever
+        self.registry.reset(f"fleet.replica{r.id}.")
+        if self.record is not None:
+            self.record.event("fleet_scale", action="drain", replica=r.id)
+        return r.id
 
     # ------------------------------------------------------------------
     # health
@@ -283,7 +355,7 @@ class ReplicaManager:
         """One health pass (public so tests drive it deterministically
         without the wall-clock loop)."""
         now = time.monotonic() if now is None else now
-        for r in self.replicas:
+        for r in list(self.replicas):
             with r._lock:
                 state, eng, due = r.state, r.engine, r.relaunch_at
             if state == R_READY and (eng is None or not eng.alive()):
@@ -352,11 +424,12 @@ class ReplicaManager:
         the elastic gauges): readiness, per-replica depth/generation,
         eject/relaunch counts."""
         g = self.registry.set_gauge
-        g("fleet.replicas", len(self.replicas))
+        replicas = list(self.replicas)
+        g("fleet.replicas", len(replicas))
         g("fleet.replicas_ready", len(self.ready_replicas()))
         g("fleet.ejects", self.ejects)
         g("fleet.relaunches", self.relaunches)
-        for r in self.replicas:
+        for r in replicas:
             d = r.depth()
             g(f"fleet.replica{r.id}.depth",
               -1.0 if d == float("inf") else d)
@@ -557,7 +630,7 @@ class FleetRouter:
     # ------------------------------------------------------------------
 
     def healthz(self) -> Dict:
-        reps = [r.describe() for r in self.manager.replicas]
+        reps = [r.describe() for r in list(self.manager.replicas)]
         ready = sum(1 for r in reps if r["state"] == R_READY)
         return {
             "ok": ready > 0,

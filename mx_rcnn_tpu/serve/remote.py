@@ -1,0 +1,707 @@
+"""Cross-host replica plane: the fleet's ``Replica`` seam over the wire.
+
+No reference equivalent — the reference is strictly single-process.
+This is ROADMAP item 2's serving half: the fleet router/manager
+interfaces were location-agnostic from PR 8 on (duck-typed engine
+surface, build_fn-launched replicas), but dispatch stopped at the
+process boundary.  :class:`RemoteEngine` is an engine-shaped proxy for
+a whole remote HOST — the per-host agent (``serve/agent.py``) runs N
+local replicas behind its own router; the head sees one remote replica
+per host and JSQ-routes across hosts with the same backlog signal it
+uses in-process.
+
+Three pieces:
+
+* **Binary wire format** for the hot prepared path: the (bh, bw, 3)
+  fp32 bucket canvas ships as raw C-order bytes behind a fixed
+  32-byte header (magic + dims + im_info + deadline), and detections
+  come back as raw fp32 rows — no JSON, no base64, no float
+  re-parsing, bit-exact both ways (``encode_prepared`` /
+  ``decode_result``; tests/test_remote.py pins round-trip equality
+  against in-process ``submit_prepared``).  JSON stays for ``submit``
+  (raw-image control path) and everything operational
+  (/healthz, /metrics, /replicas) — only the per-image hot path earns
+  a custom codec.
+
+* **Bounded per-connection pipeline**: each RemoteEngine owns
+  ``crosshost.connections`` persistent keep-alive HTTP/1.1 connections,
+  each a worker draining a shared frame queue; admission sheds once
+  ``connections x pipeline_depth`` frames are in flight toward the
+  host, so a slow or dying host backpressures the router instead of
+  absorbing an unbounded queue it may never serve.
+
+* **Remote backlog feed**: :class:`RemoteBacklogFeed` polls each
+  agent's /metrics through the PR-14 collector (per-source timeout +
+  consecutive-failure backoff — a half-open host cannot stall the
+  loop), pushes per-bucket lane depths into the RemoteEngines (the
+  router's ``bucket_depth`` signal) and appends the merged fleet view
+  into a :class:`~mx_rcnn_tpu.obs.timeseries.TimeSeriesStore` — the
+  same samples the scheduler (``serve/scheduler.py``) judges.
+
+Failure semantics mirror the in-process fleet: a transport error fails
+the frame (FAILED → the router reroutes it within its original
+deadline); ``crosshost.dead_after_failures`` consecutive transport or
+scrape failures flip ``alive()`` and the manager ejects the replica,
+whose relaunch probes the agent under the PR-6 RestartPolicy until the
+host returns.
+"""
+
+from __future__ import annotations
+
+import base64
+import http.client
+import json
+import logging
+import struct
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import urlsplit
+
+import numpy as np
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.obs.metrics import Registry, ServeMetrics
+from mx_rcnn_tpu.serve.fleet import Replica
+from mx_rcnn_tpu.serve.queue import (EXPIRED, FAILED, SERVED, SHED,
+                                     ServeRequest)
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+# ---------------------------------------------------------------------------
+# binary wire format (the prepared hot path)
+# ---------------------------------------------------------------------------
+
+# request frame: header + raw fp32 canvas.  Little-endian, packed.
+#   magic    4s   b"MXR1"
+#   version  H    1
+#   h, w, c  HHH  canvas dims (c is always 3 today; on the wire for
+#                 self-description)
+#   reserved H    0
+#   timeout_ms f  remaining budget in ms (0 = no deadline) — the HEAD
+#                 owns the absolute deadline; the wire carries the
+#                 remainder so clock skew between hosts cannot move it
+#   im_info  3f   (h, w, im_scale) fp32 record
+WIRE_MAGIC = b"MXR1"
+RESULT_MAGIC = b"MXD1"
+WIRE_VERSION = 1
+_REQ_HEAD = struct.Struct("<4sHHHHHf3f")
+_RESP_HEAD = struct.Struct("<4sHH")
+_RESP_ENTRY = struct.Struct("<HI")
+
+
+def encode_prepared(data: np.ndarray, im_info: np.ndarray,
+                    timeout_ms: float) -> bytes:
+    """(bh, bw, 3) fp32 canvas + (3,) im_info → one request frame.
+    The payload is the array's raw C-order bytes — encode/decode is a
+    memcpy, and the agent reconstructs a bit-identical array."""
+    a = np.ascontiguousarray(data, dtype=np.float32)
+    if a.ndim != 3:
+        raise ValueError(f"prepared frame wants (h, w, c), got {a.shape}")
+    h, w, c = a.shape
+    info = np.asarray(im_info, np.float32).reshape(3)
+    head = _REQ_HEAD.pack(WIRE_MAGIC, WIRE_VERSION, h, w, c, 0,
+                          float(timeout_ms or 0.0),
+                          float(info[0]), float(info[1]), float(info[2]))
+    return head + a.tobytes()
+
+
+def decode_prepared(buf: bytes) -> Tuple[np.ndarray, np.ndarray, float]:
+    """Request frame → (canvas, im_info, timeout_ms); raises ValueError
+    on any malformed frame (bad magic/version/length) so the agent can
+    answer 400 instead of crashing a handler."""
+    if len(buf) < _REQ_HEAD.size:
+        raise ValueError(f"frame truncated at {len(buf)} bytes")
+    (magic, ver, h, w, c, _rsvd, timeout_ms,
+     i0, i1, i2) = _REQ_HEAD.unpack_from(buf)
+    if magic != WIRE_MAGIC:
+        raise ValueError(f"bad frame magic {magic!r}")
+    if ver != WIRE_VERSION:
+        raise ValueError(f"unsupported wire version {ver}")
+    want = _REQ_HEAD.size + h * w * c * 4
+    if len(buf) != want:
+        raise ValueError(f"frame is {len(buf)} bytes, header asks {want}")
+    data = np.frombuffer(buf, np.float32,
+                         count=h * w * c, offset=_REQ_HEAD.size)
+    data = data.reshape(h, w, c).copy()  # own the memory (buf is transient)
+    return data, np.array([i0, i1, i2], np.float32), float(timeout_ms)
+
+
+def encode_result(dets: Dict[int, np.ndarray]) -> bytes:
+    """{class_id: (k, 5) fp32} → one result frame (raw fp32 rows — the
+    head decodes arrays bit-identical to what the remote demux
+    produced)."""
+    parts = [_RESP_HEAD.pack(RESULT_MAGIC, WIRE_VERSION, len(dets))]
+    for cid in sorted(dets):
+        arr = np.ascontiguousarray(dets[cid], dtype=np.float32)
+        if arr.ndim != 2 or arr.shape[1] != 5:
+            raise ValueError(f"class {cid} rows must be (k, 5), "
+                             f"got {arr.shape}")
+        parts.append(_RESP_ENTRY.pack(int(cid), arr.shape[0]))
+        parts.append(arr.tobytes())
+    return b"".join(parts)
+
+
+def decode_result(buf: bytes) -> Dict[int, np.ndarray]:
+    """Result frame → {class_id: (k, 5) fp32}; ValueError on malformed
+    frames."""
+    if len(buf) < _RESP_HEAD.size:
+        raise ValueError(f"result truncated at {len(buf)} bytes")
+    magic, ver, n = _RESP_HEAD.unpack_from(buf)
+    if magic != RESULT_MAGIC:
+        raise ValueError(f"bad result magic {magic!r}")
+    if ver != WIRE_VERSION:
+        raise ValueError(f"unsupported wire version {ver}")
+    off = _RESP_HEAD.size
+    out: Dict[int, np.ndarray] = {}
+    for _ in range(n):
+        if off + _RESP_ENTRY.size > len(buf):
+            raise ValueError("result entry header truncated")
+        cid, k = _RESP_ENTRY.unpack_from(buf, off)
+        off += _RESP_ENTRY.size
+        nbytes = k * 5 * 4
+        if off + nbytes > len(buf):
+            raise ValueError(f"class {cid} rows truncated")
+        out[cid] = np.frombuffer(buf, np.float32, count=k * 5,
+                                 offset=off).reshape(k, 5).copy()
+        off += nbytes
+    if off != len(buf):
+        raise ValueError(f"{len(buf) - off} trailing bytes after result")
+    return out
+
+
+def normalize_agent_url(url: str) -> str:
+    """'host:port' / full URL → scheme://host:port (no trailing slash)."""
+    if "://" not in url:
+        url = f"http://{url}"
+    return url.rstrip("/")
+
+
+# ---------------------------------------------------------------------------
+# RemoteEngine — the engine-shaped proxy for one agent
+# ---------------------------------------------------------------------------
+
+class RemoteTransportError(RuntimeError):
+    """A frame died on the wire (connect/send/recv failure) — the fleet
+    router sees FAILED and reroutes; it is never surfaced as SHED."""
+
+
+class RemoteEngine:
+    """Duck-types the :class:`~mx_rcnn_tpu.serve.engine.ServingEngine`
+    fleet surface (submit / submit_prepared / depth / bucket_depth /
+    alive / kill / close / healthz / metrics) over persistent HTTP
+    connections to one per-host agent.
+
+    ``wire`` selects the prepared-path framing: "binary" (the default —
+    the raw-fp32 frame above) or "json" (base64 canvas in a JSON body,
+    kept ONLY as the A/B control arm ``tools/loadgen.py
+    --crosshost_bench`` measures the binary format against).
+    """
+
+    def __init__(self, name: str, url: str, cfg: Config,
+                 wire: str = "binary", probe: bool = True):
+        if wire not in ("binary", "json"):
+            raise ValueError(f"wire must be binary|json, got {wire!r}")
+        self.name = name
+        self.cfg = cfg
+        self.wire = wire
+        self.agent_url = normalize_agent_url(url)
+        parts = urlsplit(self.agent_url)
+        self._host = parts.hostname or "127.0.0.1"
+        self._port = parts.port or 80
+        cc = cfg.crosshost
+        self._n_conns = max(1, int(cc.connections))
+        self._capacity = self._n_conns * max(1, int(cc.pipeline_depth))
+        self._io_timeout = float(cc.io_timeout_s)
+        self._dead_after = max(1, int(cc.dead_after_failures))
+        self.metrics = ServeMetrics()  # private registry (fleet idiom)
+        self._cond = threading.Condition()
+        self._q: deque = deque()          # (req, kind) frames to ship
+        self._closed = False
+        # liveness: transport and scrape failures counted separately —
+        # a scrape flake must not stack onto a served-traffic blip
+        self._fail_lock = threading.Lock()
+        self._transport_failures = 0
+        self._scrape_failures = 0
+        self.conns_opened = 0  # keep-alive pin (tests/test_remote.py)
+        # remote lane backlog: last scraped depths + frames we have
+        # admitted that are not yet terminal, per bucket
+        self._lane_lock = threading.Lock()
+        self._scraped_lanes: Dict[Tuple[int, int], float] = {}
+        self._local_pending: Dict[Tuple[int, int], int] = {}
+        self._last_healthz: Dict = {}
+        self._export_root = None
+        self.join_info: Dict = {}
+        if probe:
+            h = self.healthz()  # raises on a dead agent → launch fails
+            if not h.get("ok", False):
+                raise RemoteTransportError(
+                    f"agent {self.agent_url} reports not ok: {h}")
+            self._export_root = h.get("export_root")
+            self.join_info = {k: h[k] for k in
+                              ("store_pull", "replicas", "warm_s")
+                              if k in h}
+            if h.get("export_root"):
+                self.join_info["export_root"] = h["export_root"]
+        self._threads = [
+            threading.Thread(target=self._worker, name=f"{name}-conn{i}",
+                             daemon=True)
+            for i in range(self._n_conns)]
+        for t in self._threads:
+            t.start()
+
+    # ------------------------------------------------------------------
+    # admission (the fleet router's dispatch target)
+    # ------------------------------------------------------------------
+
+    def submit_prepared(self, data: np.ndarray, im_info: np.ndarray,
+                        bucket: Tuple[int, int],
+                        timeout_ms: float = None) -> ServeRequest:
+        bucket = tuple(bucket)
+        if tuple(data.shape) != bucket + (3,):
+            raise ValueError(f"prepared data shape {tuple(data.shape)} "
+                             f"does not match bucket {bucket}")
+        if data.dtype != np.float32:
+            raise ValueError(f"prepared data must be float32, "
+                             f"got {data.dtype}")
+        now = time.monotonic()
+        t = (self.cfg.serve.default_timeout_ms if timeout_ms is None
+             else timeout_ms)
+        deadline = now + t / 1000.0 if t and t > 0 else None
+        req = ServeRequest(data, np.asarray(im_info, np.float32), bucket,
+                           deadline, now)
+        return self._admit(req, "prepared")
+
+    def submit(self, img: np.ndarray,
+               timeout_ms: float = None) -> ServeRequest:
+        """Raw-image control path: ships JSON to the agent's /detect
+        (the agent preprocesses server-side — same pixels as local
+        serving by construction)."""
+        from mx_rcnn_tpu.data.image import estimate_bucket
+
+        now = time.monotonic()
+        t = (self.cfg.serve.default_timeout_ms if timeout_ms is None
+             else timeout_ms)
+        deadline = now + t / 1000.0 if t and t > 0 else None
+        h, w = img.shape[:2]
+        bucket = estimate_bucket(h, w, self.cfg.bucket.scale,
+                                 self.cfg.bucket.max_size,
+                                 self.cfg.bucket.shapes)
+        req = ServeRequest(np.ascontiguousarray(img), None, bucket,
+                           deadline, now)
+        return self._admit(req, "detect")
+
+    def _admit(self, req: ServeRequest, kind: str) -> ServeRequest:
+        self.metrics.count("submitted")
+        with self._cond:
+            shed = self._closed or self.metrics.in_flight() > self._capacity
+            if not shed:
+                self._q.append((req, kind))
+                with self._lane_lock:
+                    self._local_pending[req.bucket] = \
+                        self._local_pending.get(req.bucket, 0) + 1
+                self._cond.notify()
+        if shed:
+            if req._finish(SHED):
+                self.metrics.count("shed")
+        return req
+
+    # ------------------------------------------------------------------
+    # wire workers (one persistent connection each)
+    # ------------------------------------------------------------------
+
+    def _worker(self) -> None:
+        # the connection lives in a worker-LOCAL holder: each worker is
+        # one persistent keep-alive connection for its whole life (the
+        # reuse pin: conns_opened == connections after any burst)
+        holder = {"conn": None}
+        while True:
+            with self._cond:
+                while not self._q and not self._closed:
+                    self._cond.wait(0.5)
+                if self._closed and not self._q:
+                    break
+                req, kind = self._q.popleft()
+            self._ship(req, kind, holder)
+        self._drop_conn(holder)
+
+    def _get_conn(self, holder) -> http.client.HTTPConnection:
+        if holder["conn"] is None:
+            holder["conn"] = http.client.HTTPConnection(
+                self._host, self._port, timeout=self._io_timeout)
+            with self._fail_lock:
+                self.conns_opened += 1
+        return holder["conn"]
+
+    @staticmethod
+    def _drop_conn(holder) -> None:
+        conn, holder["conn"] = holder["conn"], None
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def _ship(self, req: ServeRequest, kind: str, holder) -> None:
+        now = time.monotonic()
+        if req.expired(now):
+            self._terminate(req, EXPIRED)
+            return
+        remaining_ms = ((req.deadline - now) * 1000.0
+                        if req.deadline is not None else 0.0)
+        if kind == "prepared" and self.wire == "binary":
+            path = "/prepared"
+            body = encode_prepared(req.image, req.im_info, remaining_ms)
+            ctype = "application/x-mxrcnn-frame"
+        elif kind == "prepared":  # the JSON/base64 A/B control arm
+            path = "/prepared_json"
+            body = json.dumps({
+                "data_b64": base64.b64encode(
+                    np.ascontiguousarray(req.image).tobytes()).decode(),
+                "shape": list(req.image.shape),
+                "im_info": [float(v) for v in req.im_info],
+                "timeout_ms": remaining_ms,
+            }).encode()
+            ctype = "application/json"
+        else:  # detect: raw image JSON control path
+            body = json.dumps({
+                "pixels_b64": base64.b64encode(req.image.tobytes()).decode(),
+                "shape": list(req.image.shape),
+                "timeout_ms": remaining_ms,
+                "raw_dets": True,
+            }).encode()
+            path = "/detect"
+            ctype = "application/json"
+        # one transparent retry on a fresh connection: a keep-alive
+        # socket the agent's server idled out raises on the FIRST write
+        # after reuse — that is connection staleness, not host death
+        for attempt in (0, 1):
+            try:
+                conn = self._get_conn(holder)
+                conn.request("POST", path, body=body,
+                             headers={"Content-Type": ctype})
+                resp = conn.getresponse()
+                payload = resp.read()
+            except Exception as e:
+                self._drop_conn(holder)
+                if attempt == 0 and not req.expired(time.monotonic()):
+                    continue
+                self._note_transport(ok=False)
+                self._terminate(req, FAILED,
+                                error=RemoteTransportError(
+                                    f"{self.agent_url}{path}: {e}"))
+                return
+            self._note_transport(ok=True)
+            self._finish_from_response(req, kind, resp.status, payload)
+            return
+
+    def _finish_from_response(self, req: ServeRequest, kind: str,
+                              status: int, payload: bytes) -> None:
+        try:
+            if status == 200:
+                if kind == "prepared" and self.wire == "binary":
+                    dets = decode_result(payload)
+                else:
+                    body = json.loads(payload.decode())
+                    dets = {int(c): np.asarray(
+                        np.frombuffer(base64.b64decode(rows), np.float32)
+                        .reshape(-1, 5))
+                        for c, rows in body["dets_b64"].items()}
+                self._terminate(req, SERVED, result=dets)
+            elif status == 429:
+                self._terminate(req, SHED)
+            elif status == 504:
+                self._terminate(req, EXPIRED)
+            else:
+                err = RemoteTransportError(
+                    f"agent answered {status}: {payload[:200]!r}")
+                self._terminate(req, FAILED, error=err)
+        except Exception as e:  # undecodable 200 body
+            self._terminate(req, FAILED, error=RemoteTransportError(
+                f"bad response payload: {e}"))
+
+    def _terminate(self, req: ServeRequest, state: str, result=None,
+                   error=None) -> None:
+        with self._lane_lock:
+            n = self._local_pending.get(req.bucket, 0)
+            if n > 1:
+                self._local_pending[req.bucket] = n - 1
+            else:
+                self._local_pending.pop(req.bucket, None)
+        if req._finish(state, result=result, error=error):
+            self.metrics.count({SERVED: "served", SHED: "shed",
+                                EXPIRED: "expired",
+                                FAILED: "failed"}[state])
+            if state == SERVED:
+                self.metrics.observe(
+                    "total_ms", (time.monotonic() - req.enqueue_t) * 1e3)
+
+    # ------------------------------------------------------------------
+    # liveness + backlog signals
+    # ------------------------------------------------------------------
+
+    def _note_transport(self, ok: bool) -> None:
+        with self._fail_lock:
+            self._transport_failures = (0 if ok
+                                        else self._transport_failures + 1)
+
+    def note_scrape(self, ok: bool) -> None:
+        """Backlog-feed liveness input: a host whose /metrics stops
+        answering is dying even if no traffic is flowing."""
+        with self._fail_lock:
+            self._scrape_failures = 0 if ok else self._scrape_failures + 1
+
+    def update_backlog(self, lanes: Dict[Tuple[int, int], float]) -> None:
+        with self._lane_lock:
+            self._scraped_lanes = dict(lanes)
+
+    def depth(self) -> int:
+        return self.metrics.in_flight()
+
+    def bucket_depth(self, bucket: Tuple[int, int]) -> int:
+        """Remote lane depth (last scrape) + frames we have in flight
+        toward that lane the scrape cannot have seen yet — the JSQ
+        batch-packing signal, kept fresh between scrapes by local
+        accounting."""
+        b = tuple(bucket)
+        with self._lane_lock:
+            return int(self._scraped_lanes.get(b, 0)
+                       + self._local_pending.get(b, 0))
+
+    def alive(self) -> bool:
+        if self._closed:
+            return False
+        with self._fail_lock:
+            return (self._transport_failures < self._dead_after
+                    and self._scrape_failures < self._dead_after)
+
+    # ------------------------------------------------------------------
+    # control surface
+    # ------------------------------------------------------------------
+
+    def _control(self, method: str, path: str, body: dict = None) -> Dict:
+        conn = http.client.HTTPConnection(
+            self._host, self._port,
+            timeout=min(self._io_timeout, 10.0))
+        try:
+            payload = json.dumps(body).encode() if body is not None else None
+            conn.request(method, path, body=payload,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise RemoteTransportError(
+                    f"{self.agent_url}{path} -> {resp.status}")
+            return json.loads(data.decode())
+        finally:
+            conn.close()
+
+    def healthz(self) -> Dict:
+        h = self._control("GET", "/healthz")
+        self._last_healthz = h
+        return h
+
+    def program_count(self) -> int:
+        return int(self._last_healthz.get("programs", 0))
+
+    def kill(self) -> None:
+        """Abrupt local death (manager eject path): fail everything we
+        still hold — the router reroutes FAILED work.  The agent itself
+        is NOT touched: its local replicas keep serving whoever else
+        routes to them."""
+        self._shutdown(FAILED, RuntimeError("replica killed"))
+
+    def close(self, timeout: float = 10.0) -> None:
+        self._shutdown(SHED, None)
+        for t in self._threads:
+            t.join(timeout)
+
+    def _shutdown(self, state: str, error) -> None:
+        with self._cond:
+            self._closed = True
+            leftovers = list(self._q)
+            self._q.clear()
+            self._cond.notify_all()
+        for req, _kind in leftovers:
+            self._terminate(req, state, error=error)
+
+
+# ---------------------------------------------------------------------------
+# RemoteReplica + fleet construction
+# ---------------------------------------------------------------------------
+
+class RemoteReplica(Replica):
+    """A managed replica whose engine is a :class:`RemoteEngine` — the
+    whole in-process lifecycle applies unchanged (launch → ready →
+    eject on death → RestartPolicy-paced relaunch); the only addition
+    is the host identity, which placement decisions read."""
+
+    @property
+    def agent_url(self) -> Optional[str]:
+        with self._lock:
+            eng = self.engine
+        return eng.agent_url if isinstance(eng, RemoteEngine) else None
+
+
+def make_remote_build_fn(cfg: Config, agent_urls: List[str]):
+    """``build_fn(rid) -> (RemoteEngine, join_stats)`` — replica rid is
+    pinned to agent ``rid % len(urls)``, so a relaunch re-probes the SAME
+    host (host identity is the replica identity; capacity moved between
+    hosts is the scheduler's job, not the relaunch path's)."""
+    urls = [normalize_agent_url(u) for u in agent_urls]
+    if not urls:
+        raise ValueError("make_remote_build_fn needs at least one agent")
+
+    def build(rid: int):
+        url = urls[rid % len(urls)]
+        eng = RemoteEngine(f"remote-{rid}", url, cfg)
+        join = dict(eng.join_info)
+        join["agent_url"] = url
+        return eng, join
+
+    return build
+
+
+def agent_urls_from_cfg(cfg: Config) -> List[str]:
+    """``cfg.crosshost.agents`` (comma-separated host:port list) →
+    normalized agent URLs — the config-declared fleet membership
+    ``tools/fleet.py serve --crosshost`` and any caller that passes no
+    explicit URL list build from."""
+    return [normalize_agent_url(u.strip())
+            for u in str(cfg.crosshost.agents).split(",") if u.strip()]
+
+
+def build_crosshost_router(cfg: Config, agent_urls: List[str] = None,
+                           registry: Registry = None, record=None,
+                           wire: str = "binary"):
+    """Head-side construction: one :class:`RemoteReplica` per agent
+    behind the standard manager/router, plus the started backlog feed.
+    ``agent_urls=None`` reads the membership from
+    ``cfg.crosshost.agents``.  Returns ``(router, feed)`` — callers own
+    ``feed.close()`` + ``router.close()``."""
+    from mx_rcnn_tpu.serve.fleet import FleetRouter, ReplicaManager
+
+    if agent_urls is None:
+        agent_urls = agent_urls_from_cfg(cfg)
+    if not agent_urls:
+        raise ValueError("build_crosshost_router needs agent URLs "
+                         "(argument or cfg.crosshost.agents)")
+    urls = [normalize_agent_url(u) for u in agent_urls]
+    cfg = cfg.replace_in("fleet", replicas=len(urls))
+
+    def build(rid: int):
+        eng = RemoteEngine(f"remote-{rid}", urls[rid % len(urls)], cfg,
+                           wire=wire)
+        join = dict(eng.join_info)
+        join["agent_url"] = eng.agent_url
+        return eng, join
+
+    manager = ReplicaManager(build, cfg, registry=registry, record=record,
+                             replica_cls=RemoteReplica).start()
+    router = FleetRouter(manager, cfg)
+    feed = RemoteBacklogFeed(router, urls, cfg)
+    feed.start()
+    return router, feed
+
+
+# ---------------------------------------------------------------------------
+# the backlog feed: collector → RemoteEngines + time-series store
+# ---------------------------------------------------------------------------
+
+def _parse_lane_gauges(gauges: Dict[str, float]
+                       ) -> Dict[Tuple[int, int], float]:
+    """Agent-published ``lane.<h>x<w>.depth`` gauges → {bucket: depth}."""
+    lanes: Dict[Tuple[int, int], float] = {}
+    for name, v in gauges.items():
+        if not (name.startswith("lane.") and name.endswith(".depth")):
+            continue
+        dims = name[len("lane."):-len(".depth")]
+        try:
+            h, w = dims.split("x")
+            lanes[(int(h), int(w))] = float(v)
+        except ValueError:
+            continue
+    return lanes
+
+
+class RemoteBacklogFeed:
+    """One poll loop per head: scrapes every agent's /metrics through
+    the PR-14 :class:`~mx_rcnn_tpu.obs.collect.Collector` (per-request
+    timeout + failure backoff — one wedged host cannot stall the loop),
+    then fans the sample out to BOTH consumers: per-bucket lane depths
+    into each :class:`RemoteEngine` (JSQ signal) and the merged
+    fleet-view snapshot into a TimeSeriesStore (scheduler signal)."""
+
+    def __init__(self, router, agent_urls: List[str], cfg: Config,
+                 store=None):
+        from mx_rcnn_tpu.obs.collect import (Collector, HttpSource,
+                                             RegistrySource)
+        from mx_rcnn_tpu.obs.timeseries import TimeSeriesStore
+
+        self.router = router
+        self.cfg = cfg
+        self._interval = max(0.05, float(cfg.crosshost.scrape_interval_s))
+        self._urls = [normalize_agent_url(u) for u in agent_urls]
+        timeout = max(self._interval, 1.0)
+        sources = [
+            HttpSource(f"agent-{i}", u, timeout_s=timeout,
+                       backoff_base_s=self._interval,
+                       backoff_cap_s=max(4 * self._interval, 2.0))
+            for i, u in enumerate(self._urls)]
+        # the head's own admission accounting (``fleet.*`` counters in
+        # the router's PRIVATE registry): sheds taken at the RemoteEngine
+        # capacity gate never cross the wire, so without this source the
+        # scheduler would read a saturated burst as "idle"
+        sources.append(RegistrySource("head", router.metrics.registry))
+        self.collector = Collector(sources)
+        self.store = store if store is not None else TimeSeriesStore(
+            capacity=cfg.obs.ts_capacity)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "RemoteBacklogFeed":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="crosshost-feed", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def _engines_by_url(self) -> Dict[str, List[RemoteEngine]]:
+        out: Dict[str, List[RemoteEngine]] = {}
+        for r in list(self.router.manager.replicas):
+            with r._lock:
+                eng, state = r.engine, r.state
+            if eng is not None and isinstance(eng, RemoteEngine):
+                out.setdefault(eng.agent_url, []).append(eng)
+        return out
+
+    def tick(self) -> Dict:
+        """One scrape+fanout pass (public so tests drive it without the
+        wall-clock loop).  Returns the collected view."""
+        from mx_rcnn_tpu.obs.collect import view_to_snapshot
+
+        view = self.collector.collect()
+        engines = self._engines_by_url()
+        for i, url in enumerate(self._urls):
+            src = view["sources"].get(f"agent-{i}", {})
+            up = bool(src.get("up"))
+            lanes = (_parse_lane_gauges(src.get("gauges", {}))
+                     if up else {})
+            for eng in engines.get(url, []):
+                eng.note_scrape(up)
+                if up:
+                    eng.update_backlog(lanes)
+        self.store.append_snapshot(view_to_snapshot(view), ts=view["ts"])
+        return view
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.tick()
+            except Exception:  # the feed must never die silently
+                logger.exception("crosshost backlog feed tick failed")

@@ -1,0 +1,309 @@
+"""Gauge-driven fleet scheduler: the control loop over the cross-host
+plane.
+
+No reference equivalent.  ROADMAP item 2 asked for a scheduler that
+consumes the PR-14 observability surface instead of inventing its own
+probes, and this module is exactly that split:
+
+* :class:`SchedulerPolicy` is PURE decision logic — it reads a
+  :class:`~mx_rcnn_tpu.obs.timeseries.TimeSeriesStore` that the head's
+  :class:`~mx_rcnn_tpu.serve.remote.RemoteBacklogFeed` is already
+  filling (one snapshot per scrape tick, per-agent gauges labeled
+  ``name@agent-i``) and returns at most one action per tick.  Tests
+  drive it with synthetic gauge traces and wall-clock-free timestamps;
+* :class:`AgentAdmin` is the actuator — it turns an action into the
+  agent's ``POST /replicas`` resize call;
+* :class:`FleetScheduler` is the thread that ties them together, with
+  the same public ``tick()``-for-tests / ``start()``-for-production
+  split as the Sampler and the backlog feed.
+
+Signals and their judgments (all windows/thresholds from
+``cfg.crosshost``):
+
+* **capacity deficit** — the summed ``agent.replicas_ready@*`` gauges
+  of the LATEST sample fall below the target.  A dead host's gauges
+  simply vanish from the sample (its HttpSource reads down), so a
+  SIGKILL shows up as a deficit within one scrape and the deficit add
+  lands on a SURVIVING agent — capacity re-placement and crash-loop
+  relaunch are the same code path;
+* **overload** — windowed shed ratio above ``up_shed_ratio`` (the
+  worse of the head's ``fleet.*`` and the summed agents' ``serve.*``
+  counter deltas — head-side capacity sheds never cross the wire, so
+  the feed scrapes the router's own registry as source ``head``), or
+  summed lane backlog per ready replica above ``up_backlog``;
+* **idle** — zero backlog, zero shed AND zero windowed traffic while
+  above ``min_replicas``.  Quiet, not merely comfortable: capacity is
+  never drained out from under live load.
+
+Every signal is judged with the obs/health.py hysteresis idiom —
+``for_samples`` consecutive breaches to act, ``idle_samples``
+consecutive clean ticks to shrink, plus a global ``cooldown_s`` after
+any action — so a single noisy tick (or the ready-dip of a replica
+mid-relaunch) never flaps the fleet (tests/test_remote.py pins
+no-flap on a breach/clean alternating trace).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.obs.timeseries import TimeSeriesStore
+from mx_rcnn_tpu.serve.remote import normalize_agent_url
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+READY_GAUGE = "agent.replicas_ready"
+LANE_PREFIX = "lane."
+# the backlog feed labels its sources agent-<i> over the ordered URL
+# list; the agents' OWN snapshots carry nested per-replica labels
+# (``...@router@agent-0``), so the source filter must be exact or a
+# single host's capacity would count once per label depth
+_AGENT_SRC = re.compile(r"^agent-\d+$")
+
+
+def _latest(store: TimeSeriesStore) -> Optional[Dict]:
+    w = store.window(None)
+    return w[-1] if w else None
+
+
+def per_agent_ready(sample: Dict) -> Dict[str, float]:
+    """{source: ready replicas} from one sample's labeled gauges.  Only
+    sources PRESENT in this sample count — a down agent contributes
+    nothing, which is precisely what makes host death legible here."""
+    out: Dict[str, float] = {}
+    pre = READY_GAUGE + "@"
+    for name, v in sample["gauges"].items():
+        if (name.startswith(pre)
+                and _AGENT_SRC.match(name[len(pre):])):
+            out[name[len(pre):]] = float(v)
+    return out
+
+
+def per_agent_backlog(sample: Dict) -> Dict[str, float]:
+    """{source: summed lane depth} from ``lane.<h>x<w>.depth@src``."""
+    out: Dict[str, float] = {}
+    for name, v in sample["gauges"].items():
+        if not (name.startswith(LANE_PREFIX) and "@" in name):
+            continue
+        body, src = name.rsplit("@", 1)
+        if not (_AGENT_SRC.match(src) and body.endswith(".depth")):
+            continue
+        out[src] = out.get(src, 0.0) + float(v)
+    return out
+
+
+class SchedulerPolicy:
+    """Pure gauge→action judgment with hysteresis.  ``decide`` returns
+    None or one action dict ``{"action": "add"|"drain", "source":
+    <agent source name>, "reason": ..., "ready": ..., "target": ...}``.
+    """
+
+    def __init__(self, cfg: Config):
+        ch = cfg.crosshost
+        self.cfg = cfg
+        # 0 = adopt whatever capacity the fleet reports on the first
+        # tick that sees a ready replica (hosts x agent_replicas at a
+        # clean boot) — the operator states intent by exception only
+        self.target = int(ch.target_replicas)
+        self._deficit_streak = 0
+        self._over_streak = 0
+        self._idle_streak = 0
+        self._cooldown_until = float("-inf")
+
+    # -- signal reads ------------------------------------------------------
+
+    def shed_ratio(self, store: TimeSeriesStore) -> float:
+        # two vantage points, worst wins: the head's ``fleet.*`` counters
+        # see every admission (including sheds taken at the RemoteEngine
+        # capacity gate, which never reach an agent), while the summed
+        # agent-side ``serve.*`` counters see engine-level shedding
+        w = self.cfg.crosshost.window_s
+        worst = 0.0
+        for pre in ("fleet.", "serve."):
+            shed = store.delta(pre + "shed", w)
+            sub = store.delta(pre + "submitted", w)
+            if not sub or sub <= 0:
+                continue
+            # an agent death shrinks the summed counters mid-window; a
+            # negative delta is an artifact of that, not negative
+            # shedding
+            worst = max(worst, max(float(shed or 0.0), 0.0) / float(sub))
+        return worst
+
+    def traffic(self, store: TimeSeriesStore) -> float:
+        """Windowed submitted-request delta (head view, agent fallback)."""
+        w = self.cfg.crosshost.window_s
+        vals = [store.delta(pre + "submitted", w)
+                for pre in ("fleet.", "serve.")]
+        vals = [float(v) for v in vals if v is not None]
+        return max(vals) if vals else 0.0
+
+    # -- judgment ----------------------------------------------------------
+
+    def decide(self, store: TimeSeriesStore,
+               now: float = None) -> Optional[Dict]:
+        now = time.monotonic() if now is None else now
+        sample = _latest(store)
+        if sample is None:
+            return None
+        ch = self.cfg.crosshost
+        ready_by = per_agent_ready(sample)
+        ready = sum(ready_by.values())
+        if not ready_by:
+            return None  # every agent down: nowhere to act
+        if self.target <= 0:
+            if ready <= 0:
+                return None  # still booting; adopt once capacity shows
+            self.target = int(min(max(ready, ch.min_replicas),
+                                  ch.max_replicas))
+            logger.info("scheduler adopted target=%d from fleet",
+                        self.target)
+        backlog_by = per_agent_backlog(sample)
+        backlog = sum(backlog_by.values())
+        shed = self.shed_ratio(store)
+        cooldown_s = ch.cooldown_s
+
+        # streaks advance every tick regardless of cooldown — a breach
+        # that persists THROUGH the cooldown acts the moment it lifts
+        self._deficit_streak = (self._deficit_streak + 1
+                                if ready < self.target else 0)
+        over = (shed > ch.up_shed_ratio
+                or (ready > 0 and backlog / ready > ch.up_backlog))
+        self._over_streak = self._over_streak + 1 if over else 0
+        # idle means QUIET, not merely comfortable: a fleet absorbing
+        # traffic with zero backlog/shed keeps its capacity — trading
+        # latency headroom away under live load is an operator call,
+        # not a gauge's
+        idle = (backlog <= 0 and shed <= 0
+                and self.traffic(store) <= 0)
+        self._idle_streak = self._idle_streak + 1 if idle else 0
+
+        if now < self._cooldown_until:
+            return None
+
+        def acted(action: Dict) -> Dict:
+            self._cooldown_until = now + cooldown_s
+            self._deficit_streak = self._over_streak = 0
+            self._idle_streak = 0
+            action.update(ready=ready, target=self.target)
+            return action
+
+        if self._deficit_streak >= ch.for_samples:
+            # re-place lost capacity on the least-loaded LIVE agent
+            src = min(sorted(ready_by), key=lambda s: ready_by[s])
+            return acted({"action": "add", "source": src,
+                          "reason": f"ready {ready:g} < target "
+                                    f"{self.target}"})
+        if (self._over_streak >= ch.for_samples
+                and ready < ch.max_replicas):
+            self.target = min(self.target + 1, ch.max_replicas)
+            src = min(sorted(ready_by), key=lambda s: ready_by[s])
+            return acted({"action": "add", "source": src,
+                          "reason": f"shed {shed:.3f} / backlog "
+                                    f"{backlog:g} over thresholds"})
+        if (self._idle_streak >= ch.idle_samples
+                and ready > max(ch.min_replicas, 1)):
+            # agents clamp their local fleet at one replica (a live
+            # host always keeps a warm engine), so only an agent with
+            # something to give back is a drain candidate — refusing
+            # here keeps the target honest instead of decrementing it
+            # against a resize the agent will reject
+            cands = [s for s in sorted(ready_by) if ready_by[s] > 1]
+            if cands:
+                self.target = max(self.target - 1, ch.min_replicas)
+                src = max(cands, key=lambda s: ready_by[s])
+                return acted({"action": "drain", "source": src,
+                              "reason": f"idle for {self._idle_streak} "
+                                        f"samples"})
+        return None
+
+
+class AgentAdmin:
+    """The actuator: source name → agent URL → ``POST /replicas``.
+    Source names follow the backlog feed's ``agent-{i}`` convention
+    over the same ordered URL list, so policy and actuator agree on
+    identity without a registry."""
+
+    def __init__(self, agent_urls: List[str], timeout_s: float = 30.0):
+        self.by_source = {f"agent-{i}": normalize_agent_url(u)
+                          for i, u in enumerate(agent_urls)}
+        self.timeout_s = float(timeout_s)
+
+    def resize(self, source: str, delta: int) -> Optional[Dict]:
+        url = self.by_source.get(source)
+        if url is None:
+            logger.warning("scheduler: unknown agent source %r", source)
+            return None
+        req = urllib.request.Request(
+            url + "/replicas",
+            data=json.dumps({"delta": int(delta)}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout_s) as r:
+                return json.loads(r.read().decode())
+        except Exception as e:
+            # the target may have died between judgment and actuation;
+            # the next tick's deficit picks a live agent instead
+            logger.warning("scheduler: resize %s via %s failed: %s",
+                           source, url, e)
+            return None
+
+
+class FleetScheduler:
+    """The control loop: judge the store, actuate on an agent, record
+    what happened.  ``tick()`` is public and synchronous for tests and
+    the bench; ``start()`` runs it on a daemon thread every
+    ``crosshost.interval_s``."""
+
+    def __init__(self, store: TimeSeriesStore, admin: AgentAdmin,
+                 cfg: Config, record=None):
+        self.policy = SchedulerPolicy(cfg)
+        self.store = store
+        self.admin = admin
+        self.cfg = cfg
+        self.record = record
+        self.actions: List[Dict] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def tick(self, now: float = None) -> Optional[Dict]:
+        action = self.policy.decide(self.store, now)
+        if action is None:
+            return None
+        delta = 1 if action["action"] == "add" else -1
+        action["result"] = self.admin.resize(action["source"], delta)
+        self.actions.append(action)
+        logger.info("scheduler: %s on %s (%s) -> %s", action["action"],
+                    action["source"], action["reason"],
+                    action["result"])
+        if self.record is not None:
+            self.record.event("fleet_schedule", **{
+                k: action[k] for k in ("action", "source", "reason")})
+        return action
+
+    def start(self) -> "FleetScheduler":
+        def loop():
+            interval = max(0.05, self.cfg.crosshost.interval_s)
+            while not self._stop.wait(interval):
+                try:
+                    self.tick()
+                except Exception:
+                    logger.exception("scheduler tick failed")
+        self._thread = threading.Thread(target=loop,
+                                        name="fleet-scheduler",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)

@@ -762,8 +762,33 @@ def main(argv=None) -> int:
     p.add_argument("--workdir", default=None,
                    help="fleet bench working directory (export stores; "
                         "default: a fresh temp dir)")
+    p.add_argument("--crosshost_bench", action="store_true",
+                   help="run the cross-host battery (subprocess agents "
+                        "+ binary wire + store pull + live scheduler — "
+                        "tools/crosshost.py) and emit CROSSHOST_r15-"
+                        "style JSON")
+    p.add_argument("--crosshost_smoke", action="store_true",
+                   help="gate-scale --crosshost_bench for `make "
+                        "crosshost-smoke` (2 hosts, short bursts)")
+    p.add_argument("--crosshost_sweep", default="1,2,4",
+                   help="host counts for the cross-host scaling legs")
+    p.add_argument("--min_wire_ratio", type=float, default=1.05,
+                   help="--check floor for binary/JSON prepared-wire "
+                        "throughput (at CPU saturation the ratio is "
+                        "total-cost-per-request, so the codec tax is "
+                        "diluted by the shared HTTP/dispatch cost — "
+                        "the per-request p50 gap in the record is the "
+                        "sharper signal)")
+    p.add_argument("--min_crosshost_scaling", type=float, default=1.9,
+                   help="--check floor for 2-host stub scaling (the "
+                        "4-host floor is 2x this)")
     add_set_arg(p)
     args = p.parse_args(argv)
+
+    if args.crosshost_bench or args.crosshost_smoke:
+        from mx_rcnn_tpu.tools.crosshost import run_crosshost_bench
+
+        return run_crosshost_bench(args)
 
     if args.fleet_bench or args.fleet_smoke:
         if args.max_join_ratio is None:

@@ -1,0 +1,522 @@
+"""Cross-host plane tests (ISSUE 15): binary wire codec bit-equality
+(standalone and against in-process ``submit_prepared``), keep-alive
+connection reuse, host death → eject → reroute within the original
+deadline, sha-verified resumable store pulls, scheduler hysteresis on
+synthetic gauge traces, and the hung-scrape backoff regression.
+
+Everything runs in-process and stubbed: "agents" are
+:class:`~mx_rcnn_tpu.serve.agent.ReplicaAgent` + ``make_agent_server``
+on loopback ports with stub run_fns (no model, no compiles), so the
+whole file is quick-tier.  The multi-PROCESS version of these claims —
+real ``tools/agent.py`` subprocesses, SIGKILL, the live scheduler — is
+the bench's job (``tools/loadgen.py --crosshost_bench``).
+"""
+
+import hashlib
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.obs.collect import (Collector, HttpSource,
+                                     RegistrySource)
+from mx_rcnn_tpu.obs.metrics import Registry
+from mx_rcnn_tpu.obs.timeseries import TimeSeriesStore
+from mx_rcnn_tpu.serve.agent import (ReplicaAgent, StorePullError,
+                                     make_agent_server,
+                                     make_store_server, pull_store)
+from mx_rcnn_tpu.serve.fleet import build_fleet
+from mx_rcnn_tpu.serve.remote import (RemoteEngine,
+                                      agent_urls_from_cfg,
+                                      build_crosshost_router,
+                                      decode_prepared, decode_result,
+                                      encode_prepared, encode_result,
+                                      normalize_agent_url)
+from mx_rcnn_tpu.serve.scheduler import (AgentAdmin, SchedulerPolicy,
+                                         per_agent_backlog,
+                                         per_agent_ready)
+from mx_rcnn_tpu.tools.loadgen import (make_content_stub_run_fn,
+                                       make_stub_run_fn)
+
+
+def _cfg(**kw):
+    over = {
+        "bucket__scale": 128, "bucket__max_size": 160,
+        "bucket__shapes": ((128, 160), (160, 128)),
+        "serve__batch_size": 2, "serve__max_delay_ms": 5.0,
+        "fleet__health_interval_s": 30.0,
+    }
+    over.update(kw)
+    return generate_config("tiny", "synthetic", **over)
+
+
+def _frame(cfg, seed=0, bucket=None):
+    b = tuple(bucket or cfg.bucket.shapes[0])
+    rng = np.random.RandomState(seed)
+    return (rng.rand(*b, 3).astype(np.float32) * 255.0,
+            np.array([b[0], b[1], 1.0], np.float32), b)
+
+
+def _start_agent(cfg, stub="content", model_ms=0.0):
+    """In-process agent + HTTP server on a free loopback port."""
+    if stub == "content":
+        factory = (lambda rid: make_content_stub_run_fn(cfg, model_ms))
+    else:
+        factory = (lambda rid: make_stub_run_fn(cfg, model_ms, seed=0))
+    ag = ReplicaAgent(cfg, None, {}, run_fn_factory=factory)
+    srv = make_agent_server(ag, "127.0.0.1", 0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return ag, srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def _stop_agent(ag, srv):
+    srv.shutdown()
+    srv.server_close()
+    ag.close()
+
+
+# ---------------------------------------------------------------------------
+# wire codec
+# ---------------------------------------------------------------------------
+
+def test_codec_prepared_round_trip_bit_equal():
+    cfg = _cfg()
+    data, info, _b = _frame(cfg, seed=3)
+    buf = encode_prepared(data, info, 1234.5)
+    out, oinfo, t = decode_prepared(buf)
+    assert out.dtype == np.float32 and out.shape == data.shape
+    assert out.tobytes() == data.tobytes()  # bit-equal, not just close
+    assert oinfo.tobytes() == info.tobytes()
+    assert t == np.float32(1234.5)
+
+
+def test_codec_prepared_rejects_malformed():
+    cfg = _cfg()
+    data, info, _b = _frame(cfg)
+    buf = encode_prepared(data, info, 0.0)
+    with pytest.raises(ValueError):
+        decode_prepared(buf[:10])           # truncated header
+    with pytest.raises(ValueError):
+        decode_prepared(b"XXXX" + buf[4:])  # bad magic
+    with pytest.raises(ValueError):
+        decode_prepared(buf[:-8])           # short payload
+    with pytest.raises(ValueError):
+        decode_prepared(buf + b"\0\0")      # trailing bytes
+    with pytest.raises(ValueError):
+        encode_prepared(data[..., 0], info, 0.0)  # not (h, w, c)
+
+
+def test_codec_result_round_trip_and_malformed():
+    rng = np.random.RandomState(0)
+    dets = {1: rng.rand(4, 5).astype(np.float32),
+            7: np.zeros((0, 5), np.float32)}
+    out = decode_result(encode_result(dets))
+    assert sorted(out) == [1, 7]
+    for cid in dets:
+        assert out[cid].tobytes() == dets[cid].tobytes()
+        assert out[cid].shape == dets[cid].shape
+    buf = encode_result(dets)
+    with pytest.raises(ValueError):
+        decode_result(buf[:4])
+    with pytest.raises(ValueError):
+        decode_result(b"YYYY" + buf[4:])
+    with pytest.raises(ValueError):
+        decode_result(buf + b"\0")
+    with pytest.raises(ValueError):
+        encode_result({1: np.zeros((2, 4), np.float32)})  # not (k, 5)
+
+
+def test_normalize_agent_url():
+    assert normalize_agent_url("127.0.0.1:9201") == "http://127.0.0.1:9201"
+    assert normalize_agent_url("http://h:1/") == "http://h:1"
+
+
+# ---------------------------------------------------------------------------
+# remote vs in-process bit-equality + keep-alive reuse
+# ---------------------------------------------------------------------------
+
+def test_remote_submit_prepared_bit_equal_to_inprocess():
+    """The tentpole pin: the same prepared frame through the binary
+    wire, the JSON control arm, and the in-process router must produce
+    IDENTICAL detections (the content stub is deterministic in the
+    batch bytes, so any wire-layer corruption shows up as a diff)."""
+    cfg = _cfg(fleet__replicas=1)
+    local = build_fleet(
+        cfg, None, {},
+        run_fn_factory=lambda rid: make_content_stub_run_fn(cfg))
+    ag, srv, url = _start_agent(cfg, stub="content")
+    try:
+        data, info, b = _frame(cfg, seed=11)
+        want = local.submit_prepared(data, info, b,
+                                     timeout_ms=10_000).wait(20.0)
+        assert want, "in-process baseline produced no detections"
+        for arm in ("binary", "json"):
+            eng = RemoteEngine(f"t-{arm}", url, cfg, wire=arm)
+            try:
+                got = eng.submit_prepared(data, info, b,
+                                          timeout_ms=10_000).wait(20.0)
+                assert sorted(got) == sorted(want), arm
+                for cid in want:
+                    assert got[cid].tobytes() == np.ascontiguousarray(
+                        want[cid], np.float32).tobytes(), (arm, cid)
+            finally:
+                eng.close()
+    finally:
+        _stop_agent(ag, srv)
+        local.close()
+
+
+def test_keep_alive_connection_reuse_pinned():
+    """A burst must ride the persistent connections: exactly
+    ``crosshost.connections`` sockets opened client-side, and the agent
+    server accepts exactly that many — no per-request reconnects."""
+    cfg = _cfg(crosshost__connections=2, crosshost__pipeline_depth=16)
+    ag, srv, url = _start_agent(cfg, stub="plain")
+    try:
+        before = srv.connections
+        eng = RemoteEngine("t-keepalive", url, cfg, probe=False)
+        try:
+            reqs = []
+            for i in range(24):
+                data, info, b = _frame(cfg, seed=i,
+                                       bucket=cfg.bucket.shapes[i % 2])
+                reqs.append(eng.submit_prepared(data, info, b,
+                                                timeout_ms=20_000))
+            for r in reqs:
+                assert r.wait(30.0) is not None
+            assert eng.conns_opened == 2
+            assert srv.connections - before == 2
+        finally:
+            eng.close()
+    finally:
+        _stop_agent(ag, srv)
+
+
+# ---------------------------------------------------------------------------
+# host death → eject → reroute within the original deadline
+# ---------------------------------------------------------------------------
+
+def test_host_death_ejects_and_reroutes_within_deadline():
+    cfg = _cfg(crosshost__connections=1, crosshost__pipeline_depth=16,
+               crosshost__dead_after_failures=2,
+               crosshost__scrape_interval_s=0.1,
+               fleet__health_interval_s=0.1,
+               fleet__reroute_retries=3)
+    agents = [_start_agent(cfg, stub="plain", model_ms=5.0)
+              for _ in range(2)]
+    router, feed = build_crosshost_router(
+        cfg, [a[2] for a in agents])
+    try:
+        # no traffic yet: the engines' worker sockets are lazy, so
+        # closing the victim's listener kills the host completely
+        _stop_agent(*agents[1][:2])
+        t0 = time.monotonic()
+        reqs = []
+        for i in range(8):
+            data, info, b = _frame(cfg, seed=i,
+                                   bucket=cfg.bucket.shapes[i % 2])
+            reqs.append(router.submit_prepared(data, info, b,
+                                               timeout_ms=15_000))
+        for r in reqs:
+            assert r.wait(20.0) is not None  # SERVED, not failed/expired
+        assert time.monotonic() - t0 < 15.0  # inside the original budget
+        deadline = time.monotonic() + 10.0
+        while router.manager.ejects < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert router.manager.ejects >= 1
+    finally:
+        feed.close()
+        router.close()
+        _stop_agent(*agents[0][:2])
+
+
+# ---------------------------------------------------------------------------
+# store pull: skip / resume / sha refusal
+# ---------------------------------------------------------------------------
+
+def _mk_store(root, sizes):
+    rng = np.random.RandomState(7)
+    os.makedirs(os.path.join(root, "sub"), exist_ok=True)
+    for rel, n in sizes.items():
+        with open(os.path.join(root, rel), "wb") as f:
+            f.write(rng.bytes(n))
+    with open(os.path.join(root, "manifest.json"), "w") as f:
+        json.dump({"files": sorted(sizes)}, f)
+
+
+def _sha(path):
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def test_store_pull_skip_resume_and_refusal(tmp_path):
+    root = str(tmp_path / "store")
+    _mk_store(root, {"a.bin": 1 << 16, "sub/b.bin": 1 << 12})
+    srv = make_store_server(root)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        d1 = str(tmp_path / "d1")
+        stats = pull_store(url, d1)
+        assert stats["files"] == 3 and not stats["refused"]
+        assert _sha(os.path.join(d1, "a.bin")) == _sha(
+            os.path.join(root, "a.bin"))
+        # idempotent re-join: everything skips, nothing transfers
+        again = pull_store(url, d1)
+        assert again["skipped"] == 3 and again["files"] == 0
+
+        # resume-after-truncation: a half-written staging file picks up
+        # with a Range request — the server log proves the offset
+        d2 = str(tmp_path / "d2")
+        os.makedirs(d2)
+        with open(os.path.join(root, "a.bin"), "rb") as f:
+            half = f.read((1 << 16) // 2)
+        with open(os.path.join(d2, "a.bin.part"), "wb") as f:
+            f.write(half)
+        stats = pull_store(url, d2)
+        assert stats["resumed"] == 1 and stats["refused"] == 0
+        assert _sha(os.path.join(d2, "a.bin")) == _sha(
+            os.path.join(root, "a.bin"))
+        with srv.stats_lock:
+            starts = [r["start"] for r in srv.requests
+                      if r["rel"] == "a.bin" and r["start"]]
+        assert starts == [len(half)]
+
+        # corrupt staging bytes: the resumed file fails sha, the pull
+        # REFUSES it, re-pulls whole, and still lands correct bytes
+        d3 = str(tmp_path / "d3")
+        os.makedirs(d3)
+        with open(os.path.join(d3, "a.bin.part"), "wb") as f:
+            f.write(b"\xff" * len(half))
+        stats = pull_store(url, d3)
+        assert stats["refused"] == 1
+        assert _sha(os.path.join(d3, "a.bin")) == _sha(
+            os.path.join(root, "a.bin"))
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_store_pull_double_mismatch_raises(tmp_path):
+    root = str(tmp_path / "store")
+    _mk_store(root, {"a.bin": 1 << 12})
+    srv = make_store_server(root)  # sha index frozen here...
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        # ...then the bytes change under it: every pull mismatches, and
+        # after the one whole-file retry the join must fail LOUDLY
+        with open(os.path.join(root, "a.bin"), "r+b") as f:
+            f.write(b"\x00" * 16)
+        with pytest.raises(StorePullError):
+            pull_store(url, str(tmp_path / "d"))
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# scheduler: synthetic gauge traces
+# ---------------------------------------------------------------------------
+
+def _sched_cfg(**kw):
+    over = {"crosshost__for_samples": 2, "crosshost__idle_samples": 3,
+            "crosshost__cooldown_s": 5.0, "crosshost__window_s": 3.0,
+            "crosshost__min_replicas": 1, "crosshost__max_replicas": 8,
+            "crosshost__up_shed_ratio": 0.05,
+            "crosshost__up_backlog": 2.0}
+    over.update(kw)
+    return _cfg(**over)
+
+
+def _snap(store, ts, ready, backlog=None, counters=None):
+    snap = {"counters": dict(counters or {}), "gauges": {}}
+    for src, v in ready.items():
+        snap["gauges"][f"agent.replicas_ready@{src}"] = v
+    for src, v in (backlog or {}).items():
+        snap["gauges"][f"lane.128x160.depth@{src}"] = v
+    store.append_snapshot(snap, ts=ts)
+
+
+def test_per_agent_parsers_ignore_nested_labels():
+    """The head re-labels agent snapshots, producing ``@router`` and
+    ``@router@agent-0`` duplicates — counting those would double a
+    host's capacity."""
+    store = TimeSeriesStore(capacity=8)
+    snap = {"counters": {}, "gauges": {
+        "agent.replicas_ready@agent-0": 2.0,
+        "agent.replicas_ready@router": 2.0,
+        "agent.replicas_ready@router@agent-0": 2.0,
+        "lane.128x160.depth@agent-0": 3.0,
+        "lane.128x160.depth@serve-1@agent-0": 3.0,
+    }}
+    smp = store.append_snapshot(snap, ts=1.0)
+    assert per_agent_ready(smp) == {"agent-0": 2.0}
+    assert per_agent_backlog(smp) == {"agent-0": 3.0}
+
+
+def test_scheduler_adopts_target_and_adds_on_deficit():
+    cfg = _sched_cfg()
+    store = TimeSeriesStore(capacity=64)
+    pol = SchedulerPolicy(cfg)
+    _snap(store, 0.0, {"agent-0": 1, "agent-1": 1})
+    assert pol.decide(store, now=0.0) is None
+    assert pol.target == 2  # adopted from the fleet, not configured
+    # host death: agent-1's gauges vanish from the sample
+    _snap(store, 1.0, {"agent-0": 1})
+    assert pol.decide(store, now=1.0) is None  # hysteresis: 1 < 2
+    _snap(store, 2.0, {"agent-0": 1})
+    act = pol.decide(store, now=2.0)
+    assert act and act["action"] == "add" and act["source"] == "agent-0"
+    # cooldown gates the next action...
+    _snap(store, 2.5, {"agent-0": 1})
+    assert pol.decide(store, now=2.5) is None
+    # ...but the streak keeps advancing through it, so a breach that
+    # outlives the cooldown acts the moment it lifts
+    _snap(store, 7.5, {"agent-0": 1})
+    act = pol.decide(store, now=7.5)
+    assert act and act["action"] == "add"
+
+
+def test_scheduler_overload_adds_and_raises_target():
+    cfg = _sched_cfg()
+    store = TimeSeriesStore(capacity=64)
+    pol = SchedulerPolicy(cfg)
+    ready = {"agent-0": 1, "agent-1": 1}
+    _snap(store, 0.0, ready, counters={"fleet.submitted": 0,
+                                       "fleet.shed": 0})
+    assert pol.decide(store, now=0.0) is None
+    for i, ts in enumerate((1.0, 2.0)):
+        _snap(store, ts, ready,
+              counters={"fleet.submitted": 100 * (i + 1),
+                        "fleet.shed": 50 * (i + 1)})
+        act = pol.decide(store, now=ts)
+    assert act and act["action"] == "add"
+    assert pol.target == 3  # overload grows intent, not just capacity
+
+
+def test_scheduler_idle_is_traffic_gated_and_floored():
+    cfg = _sched_cfg()
+    store = TimeSeriesStore(capacity=64)
+    pol = SchedulerPolicy(cfg)
+    ready = {"agent-0": 2, "agent-1": 1}
+    # comfortable but BUSY: no backlog, no shed, traffic flowing — the
+    # fleet must keep its capacity
+    for i in range(6):
+        _snap(store, float(i), ready,
+              counters={"fleet.submitted": 100 * i, "fleet.shed": 0})
+        assert pol.decide(store, now=float(i)) is None
+    # truly quiet: flat counters → drain, from the agent with >1
+    for i in range(6, 12):
+        _snap(store, float(i), ready,
+              counters={"fleet.submitted": 600, "fleet.shed": 0})
+        act = pol.decide(store, now=float(i))
+        if act:
+            break
+    assert act and act["action"] == "drain" and act["source"] == "agent-0"
+    assert pol.target == 2
+    # every agent at its 1-replica floor: idle never drains (and never
+    # decrements the target against a resize the agent would refuse)
+    pol2 = SchedulerPolicy(cfg)
+    store2 = TimeSeriesStore(capacity=64)
+    for i in range(10):
+        _snap(store2, float(i), {"agent-0": 1, "agent-1": 1})
+        assert pol2.decide(store2, now=float(i)) is None
+    assert pol2.target == 2
+
+
+def test_scheduler_no_flap_on_alternating_trace():
+    cfg = _sched_cfg()
+    store = TimeSeriesStore(capacity=64)
+    pol = SchedulerPolicy(cfg)
+    _snap(store, 0.0, {"agent-0": 1, "agent-1": 1})
+    assert pol.decide(store, now=0.0) is None
+    for i in range(1, 12):  # breach / clean / breach / clean ...
+        ready = ({"agent-0": 1} if i % 2
+                 else {"agent-0": 1, "agent-1": 1})
+        _snap(store, float(i), ready)
+        assert pol.decide(store, now=float(i)) is None
+
+
+def test_agent_admin_resize_roundtrip():
+    cfg = _cfg(crosshost__agent_replicas=1)
+    ag, srv, url = _start_agent(cfg, stub="plain")
+    try:
+        admin = AgentAdmin([url])
+        r = admin.resize("agent-0", +1)
+        assert r and r["replicas"] == 2 and r["added"] == 1
+        deadline = time.monotonic() + 20.0
+        while (len(ag.manager.ready_replicas()) < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert len(ag.manager.ready_replicas()) == 2
+        r = admin.resize("agent-0", -1)
+        assert r and r["replicas"] == 1 and r["drained"] == 1
+        # the floor: an agent never resizes below one local replica
+        r = admin.resize("agent-0", -5)
+        assert r and r["replicas"] == 1 and r["drained"] == 0
+        assert admin.resize("no-such-agent", 1) is None
+    finally:
+        _stop_agent(ag, srv)
+
+
+# ---------------------------------------------------------------------------
+# hung-scrape backoff (the obs/collect.py regression)
+# ---------------------------------------------------------------------------
+
+class _HungHandler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 — accepts, then never answers
+        time.sleep(3.0)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_hung_source_backoff_bounds_the_collect_loop():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _HungHandler)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    reg = Registry()
+    reg.set_gauge("ok.gauge", 1.0)
+    hung = HttpSource("hung",
+                      f"http://127.0.0.1:{srv.server_address[1]}",
+                      timeout_s=0.3, backoff_base_s=5.0,
+                      backoff_cap_s=10.0)
+    col = Collector([hung, RegistrySource("good", reg)])
+    try:
+        t0 = time.monotonic()
+        view = col.collect()
+        first = time.monotonic() - t0
+        assert first < 2.0  # one per-request timeout, not a 3s hang
+        assert not view["sources"]["hung"]["up"]
+        assert view["sources"]["good"]["up"]
+        assert hung.failures() == 1
+        # inside the backoff window the socket is never touched: the
+        # wedged host costs the loop (and the healthy source) nothing
+        t0 = time.monotonic()
+        view = col.collect()
+        assert time.monotonic() - t0 < 0.2
+        assert not view["sources"]["hung"]["up"]
+        assert view["sources"]["good"]["up"]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_crosshost_config_section_and_overrides():
+    cfg = generate_config("tiny", "synthetic",
+                          crosshost__connections=3,
+                          crosshost__pipeline_depth=7,
+                          crosshost__up_shed_ratio=0.2,
+                          crosshost__agents="h1:1,h2:2")
+    assert cfg.crosshost.connections == 3
+    assert cfg.crosshost.pipeline_depth == 7
+    assert cfg.crosshost.up_shed_ratio == 0.2
+    assert agent_urls_from_cfg(cfg) == ["http://h1:1", "http://h2:2"]
+    with pytest.raises(ValueError):
+        build_crosshost_router(_cfg())  # no URLs anywhere
