@@ -16,7 +16,7 @@ NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 .PHONY: all native lint test test-all test-gate serve-smoke ft-smoke \
 	obs-smoke perf-smoke elastic-smoke data-smoke fleet-smoke \
 	quant-smoke threadlint-smoke bulk-smoke crashsim-smoke \
-	health-smoke crosshost-smoke clean
+	health-smoke crosshost-smoke wirefuzz-smoke clean
 
 all: native
 
@@ -32,12 +32,16 @@ $(NATIVE_LIB): $(NATIVE_SRC)
 # threadlint-smoke); configlint = cfg.<section>.<key> reads vs the
 # config.py dataclasses + dead-key detection; persistlint = the durable
 # write surface — tmp→fsync→rename→dir-fsync→manifest-last (runtime
-# half: the crashsim enumerator, crashsim-smoke)
+# half: the crashsim enumerator, crashsim-smoke); netlint = the network
+# surface — timeouts, exception-path closes, length-checked decodes,
+# bounded reads, retry hygiene (runtime half: the wirefuzz corpus,
+# wirefuzz-smoke)
 lint:
 	python -m mx_rcnn_tpu.analysis.graphlint mx_rcnn_tpu
 	python -m mx_rcnn_tpu.analysis.threadlint mx_rcnn_tpu
 	python -m mx_rcnn_tpu.analysis.configlint mx_rcnn_tpu
 	python -m mx_rcnn_tpu.analysis.persistlint mx_rcnn_tpu
+	python -m mx_rcnn_tpu.analysis.netlint mx_rcnn_tpu
 
 # quick tier: unit + fast integration — measured ~6 min idle / 12 min
 # contended on this 1-core box (r5: 211 tests)
@@ -195,6 +199,18 @@ threadlint-smoke:
 	env MXRCNN_THREAD_SANITIZER=strict \
 		python -m mx_rcnn_tpu.tools.crashloop --elastic --smoke --check
 
+# wire-fuzz smoke (docs/ANALYSIS.md "wirefuzz"): the deterministic
+# seeded mutation corpus against the REAL MXR1/MXD1 codec in-process
+# plus a live stub agent's HTTP surface (huge/absent Content-Length,
+# trickled bodies, garbage frames, mid-frame disconnects, pipelined
+# garbage after a valid frame) — fails unless every must-reject
+# mutation costs a TYPED rejection (ValueError / 4xx) inside its
+# deadline with zero crashes/hangs/unbounded allocations, AND both
+# planted-vulnerable decoder arms (zero-fill pad, uncapped wire-length
+# alloc) are flagged — zero-sensitivity is a failure.  ~1 min.
+wirefuzz-smoke:
+	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.wirefuzz --smoke
+
 # elastic smoke (docs/FT.md "Elasticity"): a 2-process jax.distributed
 # CPU world loses one process to SIGTERM mid-epoch, shrinks onto the
 # survivor's device set (grad-accum rescaled so the global batch stays
@@ -218,11 +234,12 @@ elastic-smoke:
 # smoke (crosshost-smoke, ~2 min), the bulk kill+resume
 # smoke (bulk-smoke, ~2 min), the 2-kill crash loop (ft-smoke,
 # ~2 min), the quantized-inference smoke (quant-smoke, ~2 min), the
-# elastic shrink/grow storm (elastic-smoke, ~3 min) and the
-# sanitizer-armed serve+elastic re-run (threadlint-smoke, ~4 min)
-test-gate: lint crashsim-smoke serve-smoke perf-smoke obs-smoke \
-		health-smoke data-smoke fleet-smoke crosshost-smoke bulk-smoke \
-		quant-smoke ft-smoke elastic-smoke threadlint-smoke
+# elastic shrink/grow storm (elastic-smoke, ~3 min), the
+# sanitizer-armed serve+elastic re-run (threadlint-smoke, ~4 min) and
+# the wire-protocol fuzz of the cross-host plane (wirefuzz-smoke, ~1 min)
+test-gate: lint crashsim-smoke wirefuzz-smoke serve-smoke perf-smoke \
+		obs-smoke health-smoke data-smoke fleet-smoke crosshost-smoke \
+		bulk-smoke quant-smoke ft-smoke elastic-smoke threadlint-smoke
 	python -m pytest tests/ -x -q -m "gate"
 
 clean:

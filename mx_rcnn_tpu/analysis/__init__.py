@@ -1,7 +1,7 @@
 """Static analysis + runtime sanitizers for the repo's machine-checked
 invariants (rule catalogues and waiver syntax: docs/ANALYSIS.md).
 
-Four linters share one Finding/waiver protocol (``common.py``), each
+Five linters share one Finding/waiver protocol (``common.py``), each
 paired with a runtime twin:
 
 * ``graphlint`` — TPU-graph hygiene: the hot path is ONE XLA program
@@ -29,8 +29,22 @@ paired with a runtime twin:
   real commit workloads' write ops, enumerates every crash state the
   persistence model allows, and runs the REAL recovery paths against
   each, asserting recover-or-refuse (``make crashsim-smoke``).
+* ``netlint`` — network-surface hygiene over the cross-host plane:
+  tracked socket/connection/response objects must be timed (NL101)
+  and exception-safe (NL102), wire decodes length-checked (NL201)
+  with every peer-supplied length bounded before it sizes an
+  allocation (NL202), response/body reads byte-capped and
+  deadline-bounded through ``netio`` (NL203/NL204), and retry loops
+  backed off AND capped (NL301).  Runtime twin: ``wirefuzz.py`` — a
+  deterministic seeded mutation engine (truncations, field flips,
+  inflation arms), an allocation guard, a raw-HTTP client with
+  byte-level delivery control, and a socket-level fault proxy; the
+  driver ``tools/wirefuzz.py`` runs the corpus against the real
+  codec, a live agent, a malicious metrics server and a faulted
+  head↔agent link, with planted-vulnerable arms proving sensitivity
+  (``make wirefuzz-smoke``).
 
-All four run in ``make lint`` (first leg of ``make test-gate``):
+All five run in ``make lint`` (first leg of ``make test-gate``):
 ``python -m mx_rcnn_tpu.analysis.<tool> mx_rcnn_tpu``.
 
 Import ``RULES`` / ``lint_paths`` from the tool modules directly (kept

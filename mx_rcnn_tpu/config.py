@@ -339,6 +339,10 @@ class ServeConfig:
     score_thresh: float = 0.05  # serving detection floor (eval's 1e-3
                                 # keeps near-zero boxes the AP sweep needs;
                                 # a response wants confident boxes only)
+    # request-body admission cap (MB): a claimed Content-Length above
+    # this is refused 413 BEFORE any body byte is read; an absent one
+    # (incl. chunked transfer) is 411 (netio.read_request_body)
+    max_body_mb: float = 64.0
 
 
 @dataclass(frozen=True)
@@ -427,6 +431,20 @@ class CrosshostConfig:
     store_url: str = ""
     # replica engines each agent starts locally
     agent_replicas: int = 1
+    # wire-body cap (MB), both directions: the agent refuses request
+    # bodies claiming more (413), the head caps what it will buffer of
+    # an agent response (RemoteTransportError past it).  Sized well
+    # above the largest legitimate frame (a 1024x1024x3 fp32 prepared
+    # canvas is 12 MB) and well below harm
+    max_body_mb: float = 64.0
+    # scheduler actuation RPC deadline: a hung agent costs one resize
+    # call this much, surfaced as the typed AgentAdminTimeout — it can
+    # never wedge the scheduler tick (serve/scheduler.py)
+    admin_timeout_s: float = 5.0
+    # per-request deadline on every store-pull HTTP call (/index and
+    # each /f/<rel>); expiry surfaces as the typed StorePullError so a
+    # dead store endpoint fails the join loudly instead of hanging it
+    pull_timeout_s: float = 30.0
     # --- scheduler (serve/scheduler.py) ----------------------------------
     # fleet-wide ready-replica target (0 = adopt hosts x agent_replicas
     # at scheduler start); the host-death re-place signal: ready < target

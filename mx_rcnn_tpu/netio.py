@@ -1,0 +1,169 @@
+"""Bounded network I/O primitives for every wire surface.
+
+No reference equivalent.  PR 15 made bytes arrive from other machines
+(``serve/remote.py`` wire frames, ``serve/agent.py`` HTTP bodies,
+``obs/collect.py`` metric scrapes, ``serve/scheduler.py`` admin RPCs),
+and netlint (``analysis/netlint.py``) now demands that every one of
+those reads be *bounded by construction*: a remote peer that claims a
+multi-GB Content-Length, streams an unbounded body, or trickles bytes
+forever must cost a typed rejection, never an allocation or a wedged
+thread.  This module is the one place that discipline lives so the
+linter can model it as a single trusted helper:
+
+* :func:`read_limited` — drain an ``http.client``/``urllib`` response
+  in chunks with a hard byte cap; crossing the cap raises
+  :class:`ResponseTooLarge` (a ``ValueError``, so every existing
+  malformed-input catch path already handles it);
+* :func:`read_request_body` — the server-side twin for
+  ``BaseHTTPRequestHandler``: absent Content-Length is 411, an
+  oversized claim is 413 *before a single body byte is read*, a short
+  body (peer died mid-send) is 400.  Handlers map
+  :class:`BodyError.status` straight onto the reply;
+* :func:`check_timeout_ms` — sanitize a peer-supplied timeout before
+  it reaches deadline arithmetic: wirefuzz found that an ``inf`` in a
+  frame's timeout field reaches ``Condition.wait`` as an
+  ``OverflowError`` (a 500 for client bytes) and a ``NaN`` poisons
+  every deadline comparison.
+
+Deliberately dependency-free (stdlib only, no package imports) so both
+``serve/*`` and ``obs/*`` use it without an import cycle.
+"""
+
+from __future__ import annotations
+
+import time
+
+# default cap for control-plane JSON replies (healthz, resize results,
+# metric snapshots): far above any legitimate body, far below harm
+DEFAULT_CAP_BYTES = 8 << 20
+
+_CHUNK = 64 << 10
+
+
+class ResponseTooLarge(ValueError):
+    """A response body crossed its byte cap mid-read.  ValueError on
+    purpose: every wire consumer already routes ValueError to its typed
+    rejection path (400 / failed scrape / RemoteTransportError)."""
+
+
+class ResponseTooSlow(ValueError):
+    """A body read crossed its wall-clock deadline.  Socket timeouts
+    bound the gap BETWEEN bytes; a slow-loris peer that trickles one
+    byte per tick never trips them, so total-read time needs its own
+    bound (wirefuzz's trickle leg pins this)."""
+
+
+class BodyError(ValueError):
+    """A request body that must be refused before it is read.  Carries
+    the HTTP status the handler should reply with: 411 (no
+    Content-Length — includes chunked transfer, which the stdlib
+    handler does not decode), 413 (claimed length over the cap), 408
+    (body read past its wall-clock deadline), 400 (unparseable/negative
+    length, or a body shorter than its claim)."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = int(status)
+
+
+def read_limited(resp, max_bytes: int = DEFAULT_CAP_BYTES,
+                 what: str = "response body",
+                 deadline_s: float = None) -> bytes:
+    """Drain ``resp`` (anything with ``.read(n)``) up to ``max_bytes``.
+
+    Chunked on purpose: the cap is enforced DURING the read, so a peer
+    streaming more than it claimed (or claiming nothing at all) is cut
+    off at the cap plus one chunk, never buffered whole.  With
+    ``deadline_s`` set the TOTAL read is also wall-clock bounded: the
+    loop prefers ``read1`` (returns whatever is buffered instead of
+    blocking until a full chunk arrives) so a trickling peer is cut off
+    at the deadline, not at ``cap / bytes-per-tick`` (which is hours).
+    """
+    max_bytes = int(max_bytes)
+    t0 = time.monotonic() if deadline_s else 0.0
+    read1 = getattr(resp, "read1", None) if deadline_s else None
+    out = bytearray()
+    while True:
+        chunk = read1(_CHUNK) if read1 is not None else resp.read(_CHUNK)
+        if not chunk:
+            return bytes(out)
+        out += chunk
+        if len(out) > max_bytes:
+            raise ResponseTooLarge(
+                f"{what} exceeded the {max_bytes}-byte cap")
+        if deadline_s and time.monotonic() - t0 > deadline_s:
+            raise ResponseTooSlow(
+                f"{what} read exceeded {deadline_s:g}s "
+                f"({len(out)} bytes in)")
+
+
+# widest timeout any wire peer may request: a week in ms.  Finite but
+# huge values (one flipped exponent bit makes 1e38) still overflow
+# Condition.wait's C timestamp, so "finite" alone is not enough.
+MAX_TIMEOUT_MS = 7 * 86400 * 1000.0
+
+
+def check_timeout_ms(value, what: str = "timeout_ms"):
+    """Validate a wire-supplied timeout: ``None`` passes through (the
+    caller's default applies); anything else must be a number in
+    ``[0, MAX_TIMEOUT_MS]``.  NaN fails the ``>= 0`` comparison by IEEE
+    semantics, so one range check covers NaN, inf and negatives."""
+    if value is None:
+        return None
+    try:
+        t = float(value)
+    except (TypeError, ValueError):
+        raise ValueError(f"{what} must be a number, got {value!r}")
+    if not (0.0 <= t <= MAX_TIMEOUT_MS):
+        raise ValueError(f"{what} must be in [0, {MAX_TIMEOUT_MS:g}], "
+                         f"got {t!r}")
+    return t
+
+
+def read_request_body(handler, max_bytes: int,
+                      deadline_s: float = None) -> bytes:
+    """Read one HTTP request body off ``handler`` (a
+    ``BaseHTTPRequestHandler``) with the 411/413/400 refusal contract.
+
+    The 413 fires off the *claimed* length, before any body byte is
+    read — a multi-GB Content-Length costs the peer a rejection, not
+    this process an allocation.  ``deadline_s`` wall-clock bounds the
+    whole body read (408 past it): the handler's socket timeout only
+    bounds the gap between bytes, which a slow-loris sender never
+    exceeds.
+    """
+    claimed = handler.headers.get("Content-Length")
+    if claimed is None:
+        raise BodyError(411, "Content-Length required "
+                             "(chunked bodies are not accepted)")
+    try:
+        n = int(claimed)
+    except ValueError:
+        raise BodyError(400, f"unparseable Content-Length {claimed!r}")
+    if n < 0:
+        raise BodyError(400, f"negative Content-Length {n}")
+    if n > int(max_bytes):
+        raise BodyError(413, f"body of {n} bytes over the "
+                             f"{int(max_bytes)}-byte cap")
+    if not deadline_s:
+        body = handler.rfile.read(n)
+    else:
+        t0 = time.monotonic()
+        read1 = getattr(handler.rfile, "read1", None)
+        out = bytearray()
+        while len(out) < n:
+            want = min(_CHUNK, n - len(out))
+            chunk = (read1(want) if read1 is not None
+                     else handler.rfile.read(want))
+            if not chunk:
+                break
+            out += chunk
+            if len(out) < n and time.monotonic() - t0 > deadline_s:
+                raise BodyError(408, f"body read exceeded "
+                                     f"{deadline_s:g}s at {len(out)} "
+                                     f"of {n} bytes")
+        body = bytes(out)
+    if len(body) != n:
+        raise BodyError(400, f"body ended at {len(body)} of {n} "
+                             f"claimed bytes")
+    return body

@@ -51,6 +51,8 @@ import time
 import urllib.request
 from typing import Callable, Dict, List, Optional, Tuple
 
+from mx_rcnn_tpu.netio import read_limited
+
 logger = logging.getLogger("mx_rcnn_tpu")
 
 ScrapeResult = Optional[Tuple[Dict, Dict]]
@@ -114,7 +116,8 @@ class HttpSource:
     def __init__(self, name: str, url: str, timeout_s: float = 2.0,
                  labels: Optional[Dict] = None,
                  backoff_base_s: float = 1.0,
-                 backoff_cap_s: float = 30.0):
+                 backoff_cap_s: float = 30.0,
+                 max_bytes: int = 8 << 20):
         self.name = name
         if url.isdigit():  # bare port ("9101") = this host's exporter
             url = f"127.0.0.1:{url}"
@@ -122,6 +125,7 @@ class HttpSource:
         if not self.url.rstrip("/").endswith("/metrics"):
             self.url = self.url.rstrip("/") + "/metrics"
         self.timeout_s = float(timeout_s)
+        self.max_bytes = int(max_bytes)
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_cap_s = float(backoff_cap_s)
         self._static_labels = dict(labels or {})
@@ -140,8 +144,17 @@ class HttpSource:
         try:
             with urllib.request.urlopen(self.url,
                                         timeout=self.timeout_s) as r:
-                snap = json.loads(r.read().decode())
-        except Exception as e:  # connection refused / timeout / bad JSON
+                # capped read: a malicious/broken exporter streaming an
+                # unbounded body is a typed failure (ResponseTooLarge is
+                # a ValueError), counted and backed off like any other
+                # capped AND wall-clock bounded: a trickling exporter
+                # (one byte per tick never trips the socket timeout)
+                # is cut off as ResponseTooSlow, another ValueError
+                snap = json.loads(
+                    read_limited(r, self.max_bytes, "metrics body",
+                                 deadline_s=self.timeout_s * 4.0
+                                 ).decode())
+        except Exception as e:  # refused / timeout / bad JSON / too big
             with self._lock:
                 self._failures += 1
                 delay = min(self.backoff_cap_s,

@@ -46,6 +46,9 @@ from urllib.parse import quote, unquote, urlsplit
 import numpy as np
 
 from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.netio import (BodyError, check_timeout_ms,
+                               read_limited,
+                               read_request_body)
 from mx_rcnn_tpu.obs.metrics import LoweringCounter, Registry
 from mx_rcnn_tpu.serve.export import MANIFEST_NAME
 from mx_rcnn_tpu.serve.queue import (DeadlineExceeded, RequestFailed,
@@ -91,6 +94,7 @@ def store_index(root: str) -> Dict[str, Dict]:
 
 class _StoreHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    timeout = 60.0  # socket read deadline (stalled-peer backstop)
 
     def log_message(self, *a):  # quiet: the bench drives many requests
         pass
@@ -161,9 +165,12 @@ def make_store_server(root: str, host: str = "127.0.0.1",
 # ---------------------------------------------------------------------------
 
 class StorePullError(RuntimeError):
-    """A pulled file failed sha verification twice (resume + whole-file
-    re-pull) — the store copy is bad and warming from it would be
-    admission-refused anyway; fail the join loudly."""
+    """The typed store-join failure: a pulled file failed sha
+    verification twice (resume + whole-file re-pull), or the store
+    endpoint timed out / refused mid-pull.  Every network failure in
+    :func:`pull_store` surfaces as this one type so a joining agent
+    fails its join loudly instead of leaking a raw socket error (or
+    hanging) out of ``ReplicaAgent.__init__``."""
 
 
 def pull_store(url: str, dest: str, timeout_s: float = 30.0) -> Dict:
@@ -187,8 +194,17 @@ def pull_store(url: str, dest: str, timeout_s: float = 30.0) -> Dict:
       store).
     """
     base = normalize_agent_url(url)
-    with urllib.request.urlopen(base + "/index", timeout=timeout_s) as r:
-        index = json.loads(r.read().decode())
+    try:
+        with urllib.request.urlopen(base + "/index",
+                                    timeout=timeout_s) as r:
+            # the index is metadata (relpath -> {bytes, sha}); 16 MB is
+            # orders of magnitude above any real store's
+            index = json.loads(
+                read_limited(r, 16 << 20, "store index").decode())
+    except OSError as e:  # timeout, refused, DNS — the join must be
+        raise StorePullError(           # typed, not a raw socket error
+            f"store index pull from {base} failed "
+            f"(timeout_s={timeout_s:g}): {e}") from e
     files = index["files"]
     names = sorted(n for n in files
                    if os.path.basename(n) != MANIFEST_NAME)
@@ -208,6 +224,11 @@ def pull_store(url: str, dest: str, timeout_s: float = 30.0) -> Dict:
         if d:
             os.makedirs(d, exist_ok=True)
         part = final + ".part"
+        # finite 2-attempt resume over the .part staging file: the 2nd
+        # attempt resumes from the bytes already landed, so an immediate
+        # retry is the cheapest recovery and backoff would only delay
+        # the join; a 2nd failure raises StorePullError (no flood)
+        # netlint: disable=NL301 finite resume-retry, 2nd failure raises
         for attempt in (0, 1):
             start = (os.path.getsize(part) if os.path.exists(part)
                      else 0)
@@ -219,14 +240,22 @@ def pull_store(url: str, dest: str, timeout_s: float = 30.0) -> Dict:
             req = urllib.request.Request(base + "/f/" + quote(rel))
             if start:
                 req.add_header("Range", f"bytes={start}-")
-            with urllib.request.urlopen(req, timeout=timeout_s) as r:
-                # a 200 despite our Range means the server restarted
-                # the file — restart the staging write with it
-                mode = "ab" if (start and r.status == 206) else "wb"
-                with open(part, mode) as f:
-                    shutil.copyfileobj(r, f)
-                    f.flush()
-                    os.fsync(f.fileno())
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=timeout_s) as r:
+                    # a 200 despite our Range means the server restarted
+                    # the file — restart the staging write with it
+                    mode = "ab" if (start and r.status == 206) else "wb"
+                    with open(part, mode) as f:
+                        shutil.copyfileobj(r, f)
+                        f.flush()
+                        os.fsync(f.fileno())
+            except OSError as e:
+                if attempt == 0:
+                    continue  # one retry rides the resumable .part
+                raise StorePullError(
+                    f"{rel}: pull from {base} failed "
+                    f"(timeout_s={timeout_s:g}): {e}") from e
             if _sha256_file(part) == want["sha256"]:
                 os.replace(part, final)
                 dir_fd = os.open(d or ".", os.O_RDONLY)
@@ -278,8 +307,9 @@ class ReplicaAgent:
                 raise ValueError("crosshost.store_url needs "
                                  "fleet.export_dir as the local "
                                  "placement target")
-            self.store_pull = pull_store(cfg.crosshost.store_url,
-                                         export_root)
+            self.store_pull = pull_store(
+                cfg.crosshost.store_url, export_root,
+                timeout_s=cfg.crosshost.pull_timeout_s)
             logger.info("agent store pull: %s", self.store_pull)
         t0 = time.perf_counter()
         self.router = build_fleet(
@@ -359,8 +389,12 @@ class ReplicaAgent:
 # ---------------------------------------------------------------------------
 
 class _AgentHandler(BaseHTTPRequestHandler):
-    # the server carries .agent / .connections (see make_agent_server)
+    # the server carries .agent / .connections / .max_body_bytes
+    # (see make_agent_server)
     protocol_version = "HTTP/1.1"
+    # socket-level read deadline: a head trickling a frame one byte at
+    # a time holds one handler thread for at most this long
+    timeout = 60.0
 
     def setup(self):
         super().setup()
@@ -372,22 +406,34 @@ class _AgentHandler(BaseHTTPRequestHandler):
 
     def _reply_json(self, status: int, payload) -> None:
         body = json.dumps(payload).encode()
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        try:
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            # the peer died mid-request (wirefuzz's mid-frame
+            # disconnect): there is no one to answer, and an unhandled
+            # pipe error here would traceback out of the handler
+            self.close_connection = True
 
     def _reply_frame(self, body: bytes) -> None:
-        self.send_response(200)
-        self.send_header("Content-Type", FRAME_CTYPE)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", FRAME_CTYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            self.close_connection = True
 
     def _read_body(self) -> bytes:
-        n = int(self.headers.get("Content-Length", 0))
-        return self.rfile.read(n)
+        # 411 absent Content-Length / 413 over cap / 408 trickled past
+        # the deadline / 400 short body — the oversized claim is
+        # refused before a body byte is read
+        return read_request_body(self, self.server.max_body_bytes,
+                                 self.server.body_deadline_s)
 
     def _wait_and_reply(self, req, timeout_ms: float, binary: bool,
                         raw_dets: bool = False) -> None:
@@ -449,11 +495,14 @@ class _AgentHandler(BaseHTTPRequestHandler):
                 self._wait_and_reply(req, timeout_ms, binary=True)
             elif self.path == "/prepared_json":
                 body = json.loads(self._read_body().decode())
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
                 shape = tuple(body["shape"])
                 data = np.frombuffer(
                     base64.b64decode(body["data_b64"]),
                     np.float32).reshape(shape)
-                timeout_ms = float(body.get("timeout_ms") or 0.0)
+                timeout_ms = check_timeout_ms(
+                    body.get("timeout_ms") or 0.0)
                 req = agent.router.submit_prepared(
                     data, np.asarray(body["im_info"], np.float32),
                     shape[:2], timeout_ms=timeout_ms)
@@ -463,26 +512,38 @@ class _AgentHandler(BaseHTTPRequestHandler):
                 from mx_rcnn_tpu.serve.server import decode_image_payload
 
                 body = json.loads(self._read_body().decode())
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
                 img = decode_image_payload(body)
-                timeout_ms = float(body.get("timeout_ms") or 0.0)
+                timeout_ms = check_timeout_ms(
+                    body.get("timeout_ms") or 0.0)
                 req = agent.router.submit(img, timeout_ms=timeout_ms)
                 self._wait_and_reply(req, timeout_ms, binary=False,
                                      raw_dets=bool(body.get("raw_dets")))
             elif self.path == "/replicas":
                 body = json.loads(self._read_body().decode() or "{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
                 self._reply_json(200, agent.resize(
                     target=body.get("target"), delta=body.get("delta")))
             else:
                 self._reply_json(404, {"error": f"no route {self.path}"})
-        except ValueError as e:
-            self._reply_json(400, {"error": str(e)})
+        except BodyError as e:
+            # 411 absent Content-Length / 413 over cap / 400 short body
+            self._reply_json(e.status, {"error": str(e)})
+        except (ValueError, KeyError, TypeError) as e:
+            # malformed input is the CLIENT's fault: missing JSON keys
+            # (KeyError) and wrong-typed fields (TypeError) are 400s,
+            # never 500s — wirefuzz pins this
+            self._reply_json(400, {"error": f"{type(e).__name__}: {e}"})
         except Exception as e:
             logger.exception("agent POST %s failed", self.path)
             self._reply_json(500, {"error": str(e)})
 
 
 def make_agent_server(agent: ReplicaAgent, host: str = "127.0.0.1",
-                      port: int = 0) -> ThreadingHTTPServer:
+                      port: int = 0,
+                      max_body_mb: float = None) -> ThreadingHTTPServer:
     """Bind the agent's HTTP front end (port 0 picks a free port —
     read ``server.server_address``).  ``server.connections`` counts
     accepted sockets: with HTTP/1.1 keep-alive the head's pool should
@@ -493,4 +554,8 @@ def make_agent_server(agent: ReplicaAgent, host: str = "127.0.0.1",
     srv.agent = agent
     srv.stats_lock = threading.Lock()
     srv.connections = 0
+    if max_body_mb is None:
+        max_body_mb = agent.cfg.crosshost.max_body_mb
+    srv.max_body_bytes = int(float(max_body_mb) * (1 << 20))
+    srv.body_deadline_s = 30.0  # slow-loris bound (netio 408 contract)
     return srv

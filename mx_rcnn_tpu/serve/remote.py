@@ -62,6 +62,7 @@ from urllib.parse import urlsplit
 import numpy as np
 
 from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.netio import check_timeout_ms, read_limited
 from mx_rcnn_tpu.obs.metrics import Registry, ServeMetrics
 from mx_rcnn_tpu.serve.fleet import Replica
 from mx_rcnn_tpu.serve.queue import (EXPIRED, FAILED, SERVED, SHED,
@@ -119,6 +120,9 @@ def decode_prepared(buf: bytes) -> Tuple[np.ndarray, np.ndarray, float]:
         raise ValueError(f"bad frame magic {magic!r}")
     if ver != WIRE_VERSION:
         raise ValueError(f"unsupported wire version {ver}")
+    # a flipped bit in the timeout float must not smuggle inf/NaN into
+    # deadline arithmetic (inf reaches Condition.wait as OverflowError)
+    check_timeout_ms(timeout_ms)
     want = _REQ_HEAD.size + h * w * c * 4
     if len(buf) != want:
         raise ValueError(f"frame is {len(buf)} bytes, header asks {want}")
@@ -214,6 +218,10 @@ class RemoteEngine:
         self._n_conns = max(1, int(cc.connections))
         self._capacity = self._n_conns * max(1, int(cc.pipeline_depth))
         self._io_timeout = float(cc.io_timeout_s)
+        # response-body buffering cap: a misbehaving agent streaming
+        # past it costs a RemoteTransportError (FAILED -> reroute),
+        # never an unbounded head-side allocation
+        self._max_body = int(float(cc.max_body_mb) * (1 << 20))
         self._dead_after = max(1, int(cc.dead_after_failures))
         self.metrics = ServeMetrics()  # private registry (fleet idiom)
         self._cond = threading.Condition()
@@ -376,13 +384,15 @@ class RemoteEngine:
         # one transparent retry on a fresh connection: a keep-alive
         # socket the agent's server idled out raises on the FIRST write
         # after reuse — that is connection staleness, not host death
+        # netlint: disable=NL301 single fresh-socket retry; 2nd raises
         for attempt in (0, 1):
             try:
                 conn = self._get_conn(holder)
                 conn.request("POST", path, body=body,
                              headers={"Content-Type": ctype})
                 resp = conn.getresponse()
-                payload = resp.read()
+                payload = read_limited(resp, self._max_body,
+                                       "agent response")
             except Exception as e:
                 self._drop_conn(holder)
                 if attempt == 0 and not req.expired(time.monotonic()):
@@ -489,7 +499,8 @@ class RemoteEngine:
             conn.request(method, path, body=payload,
                          headers={"Content-Type": "application/json"})
             resp = conn.getresponse()
-            data = resp.read()
+            data = read_limited(resp, self._max_body, "control reply",
+                                deadline_s=self._io_timeout * 4)
             if resp.status != 200:
                 raise RemoteTransportError(
                     f"{self.agent_url}{path} -> {resp.status}")

@@ -48,12 +48,15 @@ from __future__ import annotations
 import json
 import logging
 import re
+import socket
 import threading
 import time
+import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
 from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.netio import read_limited
 from mx_rcnn_tpu.obs.timeseries import TimeSeriesStore
 from mx_rcnn_tpu.serve.remote import normalize_agent_url
 
@@ -225,36 +228,87 @@ class SchedulerPolicy:
         return None
 
 
+class AgentAdminError(RuntimeError):
+    """The typed actuation failure: the agent refused, answered
+    garbage, or the socket broke.  ``resize`` absorbs it into a None
+    result (the next tick's deficit re-places on a live agent), but
+    callers that must distinguish — tests, the tick record — read the
+    type off :attr:`AgentAdmin.last_error`."""
+
+
+class AgentAdminTimeout(AgentAdminError):
+    """The actuation RPC ran past ``crosshost.admin_timeout_s`` without
+    a reply — a hung (accepting-but-not-answering) agent.  Typed so a
+    wedged host costs the scheduler exactly one bounded RPC per tick,
+    never the tick itself."""
+
+
 class AgentAdmin:
     """The actuator: source name → agent URL → ``POST /replicas``.
     Source names follow the backlog feed's ``agent-{i}`` convention
     over the same ordered URL list, so policy and actuator agree on
-    identity without a registry."""
+    identity without a registry.
 
-    def __init__(self, agent_urls: List[str], timeout_s: float = 30.0):
+    Every RPC carries a hard per-request deadline (default
+    ``cfg.crosshost.admin_timeout_s`` — pass ``timeout_s`` to
+    override); expiry raises :class:`AgentAdminTimeout` inside
+    :meth:`resize`, which converts it (and every other
+    :class:`AgentAdminError`) into a logged None so one hung agent can
+    never wedge a :meth:`FleetScheduler.tick`."""
+
+    def __init__(self, agent_urls: List[str], timeout_s: float = 5.0):
         self.by_source = {f"agent-{i}": normalize_agent_url(u)
                           for i, u in enumerate(agent_urls)}
         self.timeout_s = float(timeout_s)
+        self.last_error: Optional[AgentAdminError] = None
+
+    @classmethod
+    def from_config(cls, agent_urls: List[str],
+                    cfg: Config) -> "AgentAdmin":
+        return cls(agent_urls, timeout_s=cfg.crosshost.admin_timeout_s)
+
+    def _post(self, url: str, path: str, body: Dict) -> Dict:
+        """One admin RPC with the typed-failure contract: timeout →
+        :class:`AgentAdminTimeout`, anything else (refused socket,
+        non-200, undecodable body) → :class:`AgentAdminError`."""
+        req = urllib.request.Request(
+            url + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout_s) as r:
+                return json.loads(read_limited(r, what="admin reply")
+                                  .decode())
+        except (socket.timeout, TimeoutError) as e:
+            raise AgentAdminTimeout(
+                f"{url}{path}: no reply within "
+                f"{self.timeout_s:g}s") from e
+        except urllib.error.URLError as e:
+            if isinstance(e.reason, (socket.timeout, TimeoutError)):
+                raise AgentAdminTimeout(
+                    f"{url}{path}: no reply within "
+                    f"{self.timeout_s:g}s") from e
+            raise AgentAdminError(f"{url}{path}: {e}") from e
+        except (OSError, ValueError) as e:
+            raise AgentAdminError(f"{url}{path}: {e}") from e
 
     def resize(self, source: str, delta: int) -> Optional[Dict]:
         url = self.by_source.get(source)
         if url is None:
             logger.warning("scheduler: unknown agent source %r", source)
             return None
-        req = urllib.request.Request(
-            url + "/replicas",
-            data=json.dumps({"delta": int(delta)}).encode(),
-            headers={"Content-Type": "application/json"})
         try:
-            with urllib.request.urlopen(req,
-                                        timeout=self.timeout_s) as r:
-                return json.loads(r.read().decode())
-        except Exception as e:
-            # the target may have died between judgment and actuation;
-            # the next tick's deficit picks a live agent instead
-            logger.warning("scheduler: resize %s via %s failed: %s",
-                           source, url, e)
+            result = self._post(url, "/replicas",
+                                {"delta": int(delta)})
+        except AgentAdminError as e:
+            # the target may have died (or hung) between judgment and
+            # actuation; the next tick's deficit picks a live agent
+            self.last_error = e
+            logger.warning("scheduler: resize %s via %s failed: %s: %s",
+                           source, url, type(e).__name__, e)
             return None
+        self.last_error = None
+        return result
 
 
 class FleetScheduler:
@@ -280,6 +334,12 @@ class FleetScheduler:
             return None
         delta = 1 if action["action"] == "add" else -1
         action["result"] = self.admin.resize(action["source"], delta)
+        if (action["result"] is None
+                and getattr(self.admin, "last_error", None) is not None):
+            # the typed actuation failure rides the action record, so
+            # "the agent hung" and "the agent refused" stay legible in
+            # scheduler.actions / the flight recorder
+            action["error"] = type(self.admin.last_error).__name__
         self.actions.append(action)
         logger.info("scheduler: %s on %s (%s) -> %s", action["action"],
                     action["source"], action["reason"],

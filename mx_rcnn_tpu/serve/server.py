@@ -19,6 +19,8 @@ Endpoints::
                    → 429 queue over watermark (shed)  — retry later
                    → 504 deadline expired before serve
                    → 400 malformed request, 500 engine failure
+                   → 411 body without Content-Length (incl. chunked)
+                   → 413 claimed Content-Length over serve.max_body_mb
     GET  /healthz  → 200 engine liveness + warmed-program inventory
     GET  /metrics  → 200 metrics snapshot (serve/metrics.py)
 """
@@ -35,6 +37,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from mx_rcnn_tpu.netio import (BodyError, check_timeout_ms,
+                               read_request_body)
 from mx_rcnn_tpu.obs import trace as obs_trace
 from mx_rcnn_tpu.serve.engine import ServingEngine
 from mx_rcnn_tpu.serve.queue import (DeadlineExceeded, RequestFailed,
@@ -92,16 +96,25 @@ def detections_to_json(dets, class_names: Optional[List[str]]) -> list:
 
 
 class DetectionHandler(BaseHTTPRequestHandler):
-    # the server instance carries .engine / .class_names (see make_server)
+    # the server instance carries .engine / .class_names /
+    # .max_body_bytes (see make_server)
     protocol_version = "HTTP/1.1"
+    # socket-level read deadline: a client trickling its body one byte
+    # at a time holds one handler thread for at most this long
+    timeout = 60.0
 
     def _reply(self, status: int, payload: dict) -> None:
         body = json.dumps(payload).encode()
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        try:
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            # peer died mid-request: nothing to answer, and the pipe
+            # error must not traceback out of the handler thread
+            self.close_connection = True
 
     def log_message(self, fmt, *args):  # route to the repo logger
         logger.debug("serve http: " + fmt, *args)
@@ -143,11 +156,20 @@ class DetectionHandler(BaseHTTPRequestHandler):
             return
         engine: ServingEngine = self.server.engine
         try:
-            length = int(self.headers.get("Content-Length", 0))
-            body = json.loads(self.rfile.read(length) or b"{}")
+            body = json.loads(
+                read_request_body(self, self.server.max_body_bytes,
+                                  self.server.body_deadline_s)
+                or b"{}")
             if not isinstance(body, dict):
                 raise ValueError("request body must be a JSON object")
             img = decode_image_payload(body)
+            # a peer-supplied inf/NaN timeout must die HERE as a 400,
+            # not later in deadline arithmetic (wirefuzz contract)
+            timeout_ms = check_timeout_ms(body.get("timeout_ms"))
+        except BodyError as e:
+            # 411 absent Content-Length / 413 over cap / 400 short body
+            self._reply(e.status, {"error": str(e)})
+            return
         except (ValueError, KeyError, TypeError,
                 json.JSONDecodeError) as e:
             self._reply(400, {"error": str(e)})
@@ -156,7 +178,7 @@ class DetectionHandler(BaseHTTPRequestHandler):
         try:
             # submit+wait (not engine.detect): the handle carries the
             # batch_rows the response promises
-            req = engine.submit(img, timeout_ms=body.get("timeout_ms"))
+            req = engine.submit(img, timeout_ms=timeout_ms)
             wait_s = None
             if req.deadline is not None:
                 wait_s = max(req.deadline - time.monotonic(), 0.0) + 30.0
@@ -190,11 +212,16 @@ class DetectionHandler(BaseHTTPRequestHandler):
 
 
 def make_server(engine: ServingEngine, host: str = "127.0.0.1",
-                port: int = 8080, class_names: List[str] = None
-                ) -> ThreadingHTTPServer:
+                port: int = 8080, class_names: List[str] = None,
+                max_body_mb: float = 64.0) -> ThreadingHTTPServer:
     """Build (not start) the HTTP server; ``port=0`` picks a free port
-    (read it back from ``server.server_address``)."""
+    (read it back from ``server.server_address``).  ``max_body_mb``
+    (``cfg.serve.max_body_mb`` in tools/serve.py) is the request-body
+    admission cap — a claimed length above it is refused 413 before a
+    single body byte is read."""
     srv = ThreadingHTTPServer((host, port), DetectionHandler)
     srv.engine = engine
     srv.class_names = list(class_names) if class_names else None
+    srv.max_body_bytes = int(max_body_mb * (1 << 20))
+    srv.body_deadline_s = 30.0  # slow-loris bound (netio 408 contract)
     return srv

@@ -52,6 +52,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from mx_rcnn_tpu.config import Config, generate_config
+from mx_rcnn_tpu.netio import read_limited
 from mx_rcnn_tpu.serve.queue import (DeadlineExceeded, RequestFailed,
                                      ShedError)
 from mx_rcnn_tpu.tools.loadgen import (_drain, _fleet_leg_record,
@@ -166,14 +167,14 @@ class AgentProc:
 def _scrape(url: str, timeout_s: float = 10.0) -> Dict:
     with urllib.request.urlopen(url.rstrip("/") + "/metrics",
                                 timeout=timeout_s) as r:
-        snap = json.loads(r.read().decode())
+        snap = json.loads(read_limited(r).decode())
     return snap.get("registry", snap)
 
 
 def _healthz(url: str, timeout_s: float = 10.0) -> Dict:
     with urllib.request.urlopen(url.rstrip("/") + "/healthz",
                                 timeout=timeout_s) as r:
-        return json.loads(r.read().decode())
+        return json.loads(read_limited(r).decode())
 
 
 def _prepared_set(cfg: Config, n: int, seed: int = 0) -> List[Tuple]:
@@ -471,7 +472,8 @@ def run_crosshost_bench(args) -> int:
             a.wait_ready()
         urls = [a.url for a in agents]
         router, feed = build_crosshost_router(kcfg, urls)
-        sched = FleetScheduler(feed.store, AgentAdmin(urls),
+        sched = FleetScheduler(feed.store,
+                               AgentAdmin.from_config(urls, kcfg),
                                kcfg).start()
         try:
             kdur = max(dur, 6.0)

@@ -199,7 +199,8 @@ def cmd_serve(args) -> int:
         # shape at dump time, not just its metrics
         obs_sess.flight.add_context("fleet", router.healthz)
     names = args.class_names.split(",") if args.class_names else None
-    srv = make_server(router, args.host, args.port, class_names=names)
+    srv = make_server(router, args.host, args.port, class_names=names,
+                      max_body_mb=cfg.serve.max_body_mb)
     host, port = srv.server_address[:2]
     logger.info("fleet serving on http://%s:%d  (%d replicas ready; "
                 "POST /detect, GET /healthz, GET /metrics)", host, port,

@@ -34,6 +34,8 @@ import tempfile
 import time
 import urllib.request
 
+from mx_rcnn_tpu.netio import read_limited
+
 logger = logging.getLogger("mx_rcnn_tpu")
 
 # the quick-tier miniature recipe (tests/conftest.py — shrink_tiny_cfg /
@@ -64,7 +66,7 @@ def _cfg(workdir: str, **obs_kw):
 def _scrape(port: int) -> dict:
     with urllib.request.urlopen(
             f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
-        return json.loads(resp.read())
+        return json.loads(read_limited(resp))
 
 
 def run_smoke(workdir: str, num_images: int, epochs: int) -> dict:

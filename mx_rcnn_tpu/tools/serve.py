@@ -97,7 +97,8 @@ def main(argv=None):
         # queue/warmup state at dump time, not just its metrics
         obs_sess.flight.add_context("engine", engine.healthz)
     names = args.class_names.split(",") if args.class_names else None
-    srv = make_server(engine, args.host, args.port, class_names=names)
+    srv = make_server(engine, args.host, args.port, class_names=names,
+                      max_body_mb=cfg.serve.max_body_mb)
     host, port = srv.server_address[:2]
     logger.info("serving on http://%s:%d  (POST /detect, GET /healthz, "
                 "GET /metrics)", host, port)

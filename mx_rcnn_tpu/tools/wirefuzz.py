@@ -1,0 +1,618 @@
+"""wirefuzz driver: aim the deterministic fuzzer at the real plane.
+
+No reference equivalent.  ``analysis/wirefuzz.py`` is the engine
+(seeded Mutator, alloc guard, raw-socket HTTP sender, FaultProxy);
+this driver points it at four targets and records the verdicts:
+
+* **codec** — every mutation against the in-process MXR1/MXD1
+  decoders (``serve/remote.py``) under the allocation guard and a
+  wall-clock deadline: malformed frames must die as ``ValueError``;
+* **agent** — a LIVE per-host agent (content-stub replicas): mutated
+  frames over real HTTP must come back 4xx (never 5xx, never a wedged
+  handler), plus the HTTP-level attacks — multi-GB Content-Length
+  claims (413), absent Content-Length (411), slow-trickled bodies
+  (408 at the deadline), mid-frame disconnects, garbage pipelined
+  behind a valid frame — and the server must still answer ``/healthz``
+  and serve a GOOD frame afterward;
+* **httpsource** — ``obs/collect.py``'s scraper against a malicious
+  metrics endpoint (unbounded stream, slow trickle, garbage): every
+  scrape returns ``None`` inside its deadline, memory capped;
+* **proxy** — a fault-injecting TCP proxy (truncate / reset / delay /
+  split / black-hole) between a cross-host router and one of its two
+  agents: every submitted frame must reach exactly one terminal state
+  and the healthy lane keeps serving (reroute, exactly-once).
+
+Two PLANTED ARMS prove sensitivity (a fuzzer that cannot catch a
+seeded bug proves nothing): a zero-fill-on-short-read decoder variant
+(accepts truncated frames → flagged) and an uncapped-length variant
+(allocates off the wire's row count → the alloc guard flags it).
+Both also carry netlint waivers — the static layer flags them too.
+
+Results land in ``NETFUZZ_r16.json``; ``--smoke`` is the ~1-minute
+``make wirefuzz-smoke`` subset wired into ``make test-gate``
+(docs/ANALYSIS.md "wirefuzz").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List
+
+import numpy as np
+
+from mx_rcnn_tpu.analysis.wirefuzz import (ACCEPTED_VALID, ALLOC,
+                                           CRASHED, HUNG, REJECTED,
+                                           VIOLATIONS, FaultProxy,
+                                           Mutation, Mutator,
+                                           alloc_guard, fuzz_codec,
+                                           http_case_outcome,
+                                           http_post_raw, run_case,
+                                           summarize)
+from mx_rcnn_tpu.serve.remote import (_REQ_HEAD, _RESP_ENTRY,
+                                      _RESP_HEAD, RESULT_MAGIC,
+                                      WIRE_MAGIC, decode_prepared,
+                                      decode_result, encode_prepared,
+                                      encode_result)
+
+logger = logging.getLogger("mx_rcnn_tpu")
+
+# MXR1 request header spans: load-bearing fields (a flip must reject)
+# vs data-carrying fields (a flip must merely stay typed/no-crash)
+REQ_REJECT_SPANS = [("magic", 0, 4), ("version", 4, 6),
+                    ("h", 6, 8), ("w", 8, 10), ("c", 10, 12)]
+REQ_BENIGN_SPANS = [("reserved", 12, 14), ("timeout", 14, 18),
+                    ("im_info", 18, 30)]
+# MXD1 result header + first entry: the class id is data, the row
+# COUNT is load-bearing (it sizes the decode)
+RES_REJECT_SPANS = [("magic", 0, 4), ("version", 4, 6), ("n", 6, 8),
+                    ("k0", 10, 14)]
+RES_BENIGN_SPANS = [("cid0", 8, 10)]
+
+
+def _prepared_frame(shape=(16, 20), seed=0) -> bytes:
+    rng = np.random.RandomState(seed)
+    data = (rng.rand(*shape, 3) * 255.0).astype(np.float32)
+    info = np.array([shape[0], shape[1], 1.0], np.float32)
+    return encode_prepared(data, info, 500.0)
+
+
+def _result_frame(seed=0) -> bytes:
+    rng = np.random.RandomState(seed)
+    return encode_result({1: rng.rand(4, 5).astype(np.float32),
+                          3: np.zeros((0, 5), np.float32)})
+
+
+def prepared_corpus(seed: int, shape=(16, 20)) -> List[Mutation]:
+    frame = _prepared_frame(shape)
+    inflate = bytearray(frame)
+    struct.pack_into("<HHH", inflate, 6, 0xFFFF, 0xFFFF, 0xFFFF)
+    zero = bytearray(frame[:_REQ_HEAD.size])
+    struct.pack_into("<HHH", zero, 6, 0, 0, 0)
+    extra = [
+        # dims claim 65535^3 over the same small payload: the decoder
+        # must refuse off the length MISMATCH, allocating nothing
+        Mutation("inflate:dims=65535^3", bytes(inflate), True),
+        # all-zero dims with an empty payload is self-consistent: the
+        # codec may accept it (downstream shape checks own it) but it
+        # must never crash
+        Mutation("zero-dims", bytes(zero), False),
+    ]
+    return Mutator(seed).corpus(frame, _REQ_HEAD.size, REQ_REJECT_SPANS,
+                                REQ_BENIGN_SPANS, extra=extra)
+
+
+def result_corpus(seed: int) -> List[Mutation]:
+    frame = _result_frame()
+    inflate = bytearray(frame)
+    struct.pack_into("<I", inflate, 10, 0x7FFFFFFF)  # k0 → 2^31-1 rows
+    many = bytearray(frame)
+    struct.pack_into("<H", many, 6, 0xFFFF)          # n → 65535 entries
+    extra = [Mutation("inflate:k0=2^31-1", bytes(inflate), True),
+             Mutation("inflate:n=65535", bytes(many), True)]
+    return Mutator(seed).corpus(frame, _RESP_HEAD.size, RES_REJECT_SPANS,
+                                RES_BENIGN_SPANS, extra=extra)
+
+
+# ---------------------------------------------------------------------------
+# leg A: in-process codec
+# ---------------------------------------------------------------------------
+
+def leg_codec(seed: int, smoke: bool = False) -> Dict:
+    shapes = ([(16, 20)] if smoke
+              else [(16, 20), (40, 24), (8, 12)])
+    results: List[Dict] = []
+    for i, shape in enumerate(shapes):
+        muts = prepared_corpus(seed + i, shape)
+        results += fuzz_codec(decode_prepared, muts)
+    for j in (7, 9) if not smoke else (7,):
+        results += fuzz_codec(decode_result, result_corpus(seed + j))
+    out = summarize(results)
+    out["target"] = "decode_prepared/decode_result"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# leg B: live agent over real HTTP
+# ---------------------------------------------------------------------------
+
+def _mk_cfg(**kw):
+    from mx_rcnn_tpu.config import generate_config
+
+    over = {"bucket__scale": 128, "bucket__max_size": 160,
+            "bucket__shapes": ((128, 160), (160, 128)),
+            "serve__batch_size": 2, "serve__max_delay_ms": 5.0,
+            "fleet__replicas": 1, "fleet__health_interval_s": 30.0}
+    over.update(kw)
+    return generate_config("tiny", "synthetic", **over)
+
+
+def _start_agent(cfg, body_deadline_s: float = None):
+    from mx_rcnn_tpu.serve.agent import ReplicaAgent, make_agent_server
+    from mx_rcnn_tpu.tools.loadgen import make_content_stub_run_fn
+
+    ag = ReplicaAgent(cfg, None, {}, run_fn_factory=(
+        lambda rid: make_content_stub_run_fn(cfg)))
+    srv = make_agent_server(ag, "127.0.0.1", 0)
+    if body_deadline_s is not None:
+        srv.body_deadline_s = body_deadline_s
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    host, port = srv.server_address[:2]
+    return ag, srv, host, port
+
+
+def _stop_agent(ag, srv):
+    srv.shutdown()
+    srv.server_close()
+    ag.close()
+
+
+def _good_frame(cfg) -> bytes:
+    b = tuple(cfg.bucket.shapes[0])
+    rng = np.random.RandomState(5)
+    data = (rng.rand(*b, 3) * 255.0).astype(np.float32)
+    return encode_prepared(data,
+                           np.array([b[0], b[1], 1.0], np.float32),
+                           10_000.0)
+
+
+def _healthz_ok(host: str, port: int, timeout_s: float = 10.0) -> bool:
+    import urllib.request
+
+    from mx_rcnn_tpu.netio import read_limited
+
+    with urllib.request.urlopen(f"http://{host}:{port}/healthz",
+                                timeout=timeout_s) as r:
+        return (r.status == 200
+                and bool(json.loads(read_limited(r).decode()).get("ok")))
+
+
+def leg_agent(seed: int, smoke: bool = False) -> Dict:
+    deadline_s = 15.0
+    cfg = _mk_cfg()
+    ag, srv, host, port = _start_agent(cfg, body_deadline_s=2.0)
+    results: List[Dict] = []
+
+    def record(case: str, outcome: str, detail: str = None):
+        r = {"case": case, "outcome": outcome}
+        if detail:
+            r["detail"] = detail
+        results.append(r)
+
+    try:
+        good = _good_frame(cfg)
+        # mutated frames over the wire: the per-shape corpus is built
+        # on the small frame (fast), shipped as /prepared bodies
+        muts = [m for m in prepared_corpus(seed, (16, 20))
+                if m.must_reject]
+        if smoke:
+            muts = muts[::4]
+        for m in muts:
+            res = http_post_raw(host, port, "/prepared", m.data)
+            record(f"http:{m.name}",
+                   http_case_outcome(res, True, deadline_s),
+                   res.get("error"))
+        # HTTP-level attacks
+        for case, kw, want in [
+            ("huge-content-length",
+             dict(body=good[:64], content_length=3 << 30), 413),
+            ("absent-content-length",
+             dict(body=good, content_length="absent"), 411),
+            ("trickle-past-deadline",
+             dict(body=good, mode="trickle", trickle_bytes=10 ** 9,
+                  trickle_delay_s=0.05, timeout_s=30.0), 408),
+            ("garbage-json-detect",
+             dict(path="/detect", body=b"\xff\xfe{{{",
+                  ctype="application/json"), 400),
+            ("wrong-route",
+             dict(path="/nope", body=b"x"), 404),
+        ]:
+            kw.setdefault("path", "/prepared")
+            res = http_post_raw(host, port, **kw)
+            ok = res.get("status") == want
+            record(f"http:{case}",
+                   REJECTED if ok else CRASHED,
+                   None if ok else f"want {want}, got {res}")
+        # trickle note: the sender gives up when the server's 408
+        # arrives (the read side unblocks) — elapsed must sit near the
+        # server's 2 s body deadline, not the client's 30 s budget
+        # mid-frame disconnect: no response expected, server survives
+        res = http_post_raw(host, port, "/prepared", good,
+                            mode="disconnect")
+        record("http:mid-frame-disconnect",
+               REJECTED if res.get("error") == "client-disconnect"
+               else CRASHED)
+        # garbage pipelined behind a valid frame on one connection:
+        # the first response must be an intact 200
+        sock = socket.create_connection((host, port), timeout=deadline_s)
+        try:
+            head = (f"POST /prepared HTTP/1.1\r\nHost: f\r\n"
+                    f"Content-Type: application/x-mxr1\r\n"
+                    f"Content-Length: {len(good)}\r\n\r\n").encode()
+            sock.sendall(head + good + b"\x07GARBAGE NOT HTTP\r\n\r\n")
+            first = sock.recv(64)
+            ok = first.startswith(b"HTTP/1.1 200")
+            record("http:pipelined-garbage",
+                   ACCEPTED_VALID if ok else CRASHED,
+                   None if ok else repr(first[:40]))
+        finally:
+            sock.close()
+        # aftermath: the server still answers /healthz and serves a
+        # good frame — no fuzz case may have wedged it
+        record("aftermath:healthz",
+               ACCEPTED_VALID if _healthz_ok(host, port) else CRASHED)
+        res = http_post_raw(host, port, "/prepared", good,
+                            timeout_s=30.0)
+        record("aftermath:good-frame",
+               ACCEPTED_VALID if res.get("status") == 200 else CRASHED,
+               None if res.get("status") == 200 else str(res))
+    finally:
+        _stop_agent(ag, srv)
+    out = summarize(results)
+    out["target"] = f"live agent http://{host}:{port}"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# leg C: HttpSource vs a malicious metrics endpoint
+# ---------------------------------------------------------------------------
+
+class _EvilMetrics:
+    """A metrics endpoint that misbehaves on purpose: ``good`` (valid
+    snapshot), ``garbage`` (200 with non-JSON), ``flood`` (streams
+    zeros far past any cap), ``trickle`` (one byte per tick, forever —
+    the slow-loris that never trips a socket timeout)."""
+
+    def __init__(self, behavior: str):
+        self.behavior = behavior
+        self._stop = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.settimeout(0.25)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.address = self._sock.getsockname()[:2]
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._stop.set()
+        self._sock.close()
+        self._thread.join(timeout=5.0)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        conn.settimeout(10.0)
+        try:
+            buf = b""
+            while b"\r\n\r\n" not in buf and len(buf) < 65536:
+                d = conn.recv(4096)
+                if not d:
+                    return
+                buf += d
+            if self.behavior == "good":
+                body = json.dumps({"counters": {"up": 1.0},
+                                   "gauges": {}, "hists": {}}).encode()
+                conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Type: "
+                             b"application/json\r\nContent-Length: "
+                             + str(len(body)).encode() + b"\r\n\r\n"
+                             + body)
+            elif self.behavior == "garbage":
+                body = b"<html>definitely not a registry snapshot"
+                conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: "
+                             + str(len(body)).encode() + b"\r\n\r\n"
+                             + body)
+            elif self.behavior == "flood":
+                conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: "
+                             b"1073741824\r\n\r\n")
+                chunk = b"\0" * 65536
+                while not self._stop.is_set():
+                    conn.sendall(chunk)
+            elif self.behavior == "trickle":
+                conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Length: "
+                             b"1000000\r\n\r\n")
+                while not self._stop.is_set():
+                    conn.sendall(b"{")
+                    time.sleep(0.05)
+        except OSError:
+            pass  # the scraper hung up: exactly what we want
+        finally:
+            conn.close()
+
+
+def leg_httpsource(seed: int) -> Dict:
+    from mx_rcnn_tpu.obs.collect import HttpSource
+
+    results: List[Dict] = []
+    for behavior, must_fail in [("good", False), ("garbage", True),
+                                ("flood", True), ("trickle", True)]:
+        ev = _EvilMetrics(behavior)
+        try:
+            host, port = ev.address
+            src = HttpSource(f"evil-{behavior}", f"{host}:{port}",
+                             timeout_s=0.5, max_bytes=64 << 10)
+            t0 = time.monotonic()
+            got = src.scrape()
+            dt = time.monotonic() - t0
+            # deadline = timeout_s (connect+headers) + 4x timeout_s
+            # (read_limited's wall bound) + slack
+            if dt > 0.5 * 4 + 2.0:
+                outcome = HUNG
+            elif must_fail:
+                outcome = REJECTED if got is None else "accepted_malformed"
+            else:
+                outcome = (ACCEPTED_VALID if got is not None
+                           else CRASHED)
+            results.append({"case": f"scrape:{behavior}",
+                            "outcome": outcome,
+                            "detail": f"{dt:.2f}s"})
+        finally:
+            ev.close()
+    out = summarize(results)
+    out["target"] = "obs.collect.HttpSource"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# leg D: fault proxy between head and agent (reroute + exactly-once)
+# ---------------------------------------------------------------------------
+
+def leg_proxy(seed: int) -> Dict:
+    from mx_rcnn_tpu.serve.queue import (DeadlineExceeded, RequestFailed,
+                                         ShedError)
+    from mx_rcnn_tpu.serve.remote import build_crosshost_router
+
+    cfg = _mk_cfg(crosshost__connections=1,
+                  crosshost__pipeline_depth=16,
+                  crosshost__io_timeout_s=2.0,
+                  crosshost__dead_after_failures=20,
+                  crosshost__scrape_interval_s=0.25,
+                  fleet__health_interval_s=0.25,
+                  fleet__reroute_retries=3)
+    # every connection accepted while a step is active gets that
+    # step's fault; kill_live() between steps forces the head's
+    # keep-alive connections to re-handshake INTO the new fault
+    holder = {"mode": "pass"}
+
+    a0 = _start_agent(cfg)
+    a1 = _start_agent(cfg)
+    proxy = FaultProxy(a0[2], a0[3],
+                       schedule=lambda i: holder["mode"], seed=seed)
+    router = feed = None
+    results: List[Dict] = []
+    terminal = {"served": 0, "failed": 0, "expired": 0, "shed": 0}
+
+    def submit_pair(tag: str, rng):
+        reqs = []
+        for i in range(2):
+            b = tuple(cfg.bucket.shapes[i % 2])
+            data = (rng.rand(*b, 3) * 255.0).astype(np.float32)
+            info = np.array([b[0], b[1], 1.0], np.float32)
+            reqs.append(router.submit_prepared(data, info, b,
+                                               timeout_ms=15_000))
+        for i, r in enumerate(reqs):
+            try:
+                dets = r.wait(timeout=25.0)
+                state = "served" if dets is not None else "failed"
+            except ShedError:
+                state = "shed"
+            except DeadlineExceeded:
+                state = "expired"
+            except (RequestFailed, TimeoutError) as e:
+                # a bare wait-timeout means the request never went
+                # terminal: the exactly-once violation
+                if isinstance(e, TimeoutError):
+                    results.append({"case": f"{tag}-req{i}",
+                                    "outcome": HUNG})
+                    continue
+                state = "failed"
+            terminal[state] += 1
+            results.append({"case": f"{tag}-req{i}", "outcome":
+                            ACCEPTED_VALID if state == "served"
+                            else REJECTED})
+
+    try:
+        router, feed = build_crosshost_router(
+            cfg, [f"http://{proxy.address[0]}:{proxy.address[1]}",
+                  f"http://{a1[2]}:{a1[3]}"])
+        rng = np.random.RandomState(seed)
+        for mode in ("pass", "truncate", "reset", "split", "delay",
+                     "blackhole", "pass"):
+            holder["mode"] = mode
+            proxy.kill_live()  # force reconnect under the new fault
+            submit_pair(mode, rng)
+        # reroute: the healthy lane must have absorbed every fault —
+        # each request served inside its original deadline
+        if terminal["served"] < 12:
+            results.append({"case": "reroute-served", "outcome": CRASHED,
+                            "detail": str(terminal)})
+        if not _healthz_ok(a1[2], a1[3]):
+            results.append({"case": "aftermath:agent1-healthz",
+                            "outcome": CRASHED})
+        out = summarize(results)
+        out["terminal"] = terminal
+        out["faults_applied"] = list(proxy.faults_applied)
+    finally:
+        if feed is not None:
+            feed.close()
+        if router is not None:
+            router.close()
+        proxy.close()
+        _stop_agent(a0[0], a0[1])
+        _stop_agent(a1[0], a1[1])
+    out["target"] = "crosshost router through FaultProxy"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# planted arms: the sensitivity proof
+# ---------------------------------------------------------------------------
+
+def _decode_prepared_zerofill(buf: bytes):
+    """PLANTED ARM, never wired into serving: the classic broken
+    decoder that pads a short read with zeros instead of rejecting it.
+    wirefuzz must flag it (truncations decode "fine") and netlint
+    already does statically — the waivers below are the proof both
+    layers see it."""
+    # netlint: disable=NL202 planted arm: zero-fill pad sized off wire
+    b = bytes(buf) + b"\0" * max(0, _REQ_HEAD.size - len(buf))
+    # netlint: disable=NL201 planted arm: unpack with no length check
+    parts = _REQ_HEAD.unpack_from(b)
+    magic, _ver, h, w, c = parts[0], parts[1], parts[2], parts[3], parts[4]
+    if magic != WIRE_MAGIC:
+        raise ValueError(f"bad frame magic {magic!r}")
+    want = _REQ_HEAD.size + h * w * c * 4
+    if len(b) < want:
+        b = b + b"\0" * (want - len(b))  # zero-fill the missing bytes
+    data = np.frombuffer(b, np.float32, count=h * w * c,
+                         offset=_REQ_HEAD.size)
+    return data.reshape(h, w, c)
+
+
+def _decode_result_uncapped(buf: bytes):
+    """PLANTED ARM, never wired into serving: trusts the wire's row
+    count to size an allocation BEFORE any bounds check — the alloc
+    guard must flag the 2^31-row inflation as AllocationCapExceeded
+    (and truncations crash as struct.error, not ValueError)."""
+    # netlint: disable=NL201 planted arm: unpack with no length check
+    magic, _ver, n = _RESP_HEAD.unpack_from(buf)
+    if magic != RESULT_MAGIC:
+        raise ValueError(f"bad result magic {magic!r}")
+    off = _RESP_HEAD.size
+    out = {}
+    for _ in range(n):
+        # netlint: disable=NL201,NL202 planted arm: wire k sizes zeros
+        cid, k = _RESP_ENTRY.unpack_from(buf, off)
+        off += _RESP_ENTRY.size
+        # netlint: disable=NL202 planted arm: unbounded wire-sized alloc
+        rows = np.zeros((k, 5), np.float32)
+        avail = np.frombuffer(buf, np.uint8, count=min(
+            k * 20, max(0, len(buf) - off)), offset=off)
+        rows.reshape(-1)[:avail.size // 4] = avail[
+            :avail.size // 4 * 4].view(np.float32)
+        out[cid] = rows
+        off += k * 20
+    return out
+
+
+def leg_planted(seed: int) -> Dict:
+    # the zero-fill arm sees truncations + flips only: its inflation
+    # "acceptance" would be a multi-GB bytes pad, which is the OTHER
+    # arm's job to demonstrate (under the guard)
+    zf_muts = [m for m in prepared_corpus(seed, (16, 20))
+               if m.name.startswith(("trunc@", "flip:", "header-only"))]
+    zf = summarize(run_case(_decode_prepared_zerofill, m,
+                            alloc_cap=256 << 20) for m in zf_muts)
+    un = summarize(fuzz_codec(_decode_result_uncapped,
+                              result_corpus(seed)))
+    zf_flagged = len(zf["violations"]) > 0
+    un_flagged = any(v["outcome"] == ALLOC for v in un["violations"])
+    return {
+        "zerofill": {"cases": zf["cases"], "outcomes": zf["outcomes"],
+                     "flagged": zf_flagged},
+        "uncapped": {"cases": un["cases"], "outcomes": un["outcomes"],
+                     "alloc_flagged": un_flagged,
+                     "flagged": len(un["violations"]) > 0},
+        "ok": zf_flagged and un_flagged,
+    }
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def run(seed: int = 16, smoke: bool = False) -> Dict:
+    t0 = time.monotonic()
+    legs: Dict[str, Dict] = {}
+    legs["codec"] = leg_codec(seed, smoke=smoke)
+    legs["agent"] = leg_agent(seed, smoke=smoke)
+    if not smoke:
+        legs["httpsource"] = leg_httpsource(seed)
+        legs["proxy"] = leg_proxy(seed)
+    planted = leg_planted(seed)
+    cases = sum(d["cases"] for d in legs.values())
+    violations = [dict(v, leg=name) for name, d in legs.items()
+                  for v in d["violations"]]
+    doc = {
+        "metric": "wirefuzz_violations",
+        "value": len(violations),
+        "seed": seed,
+        "smoke": smoke,
+        "corpus_cases": cases,
+        "legs": legs,
+        "planted": planted,
+        "ok": not violations and planted["ok"],
+        "elapsed_s": round(time.monotonic() - t0, 1),
+    }
+    return doc
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Deterministic wire-protocol fuzz of the cross-host "
+                    "plane (docs/ANALYSIS.md 'wirefuzz')")
+    p.add_argument("--seed", type=int, default=16)
+    p.add_argument("--smoke", action="store_true",
+                   help="~1 min subset for make test-gate (codec + "
+                        "live-agent + planted arms)")
+    p.add_argument("--out", default=None,
+                   help="write the result JSON here "
+                        "(full runs default to NETFUZZ_r16.json)")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.WARNING)
+    doc = run(seed=args.seed, smoke=args.smoke)
+    out = args.out
+    if out is None and not args.smoke:
+        out = "NETFUZZ_r16.json"
+    if out:
+        with open(out, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+    brief = {k: doc[k] for k in ("metric", "value", "corpus_cases",
+                                 "ok", "elapsed_s")}
+    brief["planted_ok"] = doc["planted"]["ok"]
+    print(json.dumps(brief))
+    if doc["value"]:
+        for v in [dict(v, leg=name) for name, d in doc["legs"].items()
+                  for v in d["violations"]]:
+            print(json.dumps(v))
+    return 0 if doc["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -68,18 +68,24 @@ def _parse_mxnet_params(path: str) -> Dict[str, np.ndarray]:
 
     def u32():
         nonlocal off
+        if off + 4 > len(data):
+            raise ValueError(f"{path}: truncated params file")
         (v,) = struct.unpack_from("<I", data, off)
         off += 4
         return v
 
     def i32():
         nonlocal off
+        if off + 4 > len(data):
+            raise ValueError(f"{path}: truncated params file")
         (v,) = struct.unpack_from("<i", data, off)
         off += 4
         return v
 
     def u64():
         nonlocal off
+        if off + 8 > len(data):
+            raise ValueError(f"{path}: truncated params file")
         (v,) = struct.unpack_from("<Q", data, off)
         off += 8
         return v
@@ -96,16 +102,22 @@ def _parse_mxnet_params(path: str) -> Dict[str, np.ndarray]:
             if stype != -1:
                 raise ValueError(f"{path}: sparse arrays unsupported")
             ndim = u32()
+            if off + 8 * ndim > len(data):
+                raise ValueError(f"{path}: truncated params file")
             shape = struct.unpack_from(f"<{ndim}q", data, off)
             off += 8 * ndim
         elif magic == _NDARRAY_V1:
             ndim = u32()
+            if off + 8 * ndim > len(data):
+                raise ValueError(f"{path}: truncated params file")
             shape = struct.unpack_from(f"<{ndim}q", data, off)
             off += 8 * ndim
         else:  # legacy: magic was the ndim of a uint32 shape
             ndim = magic
             if ndim > 8:
                 raise ValueError(f"{path}: unrecognized ndarray header")
+            if off + 4 * ndim > len(data):
+                raise ValueError(f"{path}: truncated params file")
             shape = struct.unpack_from(f"<{ndim}I", data, off)
             off += 4 * ndim
         i32()  # dev_type

@@ -1,0 +1,90 @@
+"""netlint fixture: every NL rule trips at least once.
+
+NEVER imported — ``tests/test_netlint.py`` lints this file and asserts
+the finding set covers the whole rule catalogue, so a rule that
+silently stops firing fails the suite.  Mirrors
+``tests/fixtures/serve/threadlint_bad.py`` /
+``tests/fixtures/ft/persistlint_bad.py``.
+"""
+
+import socket
+import struct
+import urllib.request
+
+
+def nl101_blocking_on_untimed(addr):
+    # allocated with no timeout= and no settimeout — the recv wedges
+    # this thread forever against a half-open peer
+    s = socket.create_connection(addr)
+    try:
+        return s.recv(1024)  # NL101 fires here
+    finally:
+        s.close()
+
+
+def nl102_leaked_on_exception(addr):
+    # timed (so no NL101) but the close is unconditional code that an
+    # exception skips: no with, no finally, no ownership hand-off
+    s = socket.create_connection(addr, timeout=5.0)  # NL102 fires here
+    s.sendall(b"hello")
+    data = s.recv(64)
+    s.close()
+    return data
+
+
+def nl201_unpack_without_length_check(buf):
+    # a truncated frame dies as struct.error, not the decoder's typed
+    # ValueError
+    magic, n = struct.unpack("<4sI", buf[:8])  # NL201 fires here
+    return magic, n
+
+
+def nl202_wire_length_sizes_alloc(buf):
+    if len(buf) < 8:
+        raise ValueError("short frame")
+    n, = struct.unpack_from("<I", buf, 4)
+    return bytearray(n)  # NL202 fires here: n is wire-derived, unbounded
+
+
+def nl203_argless_response_read(url):
+    with urllib.request.urlopen(url, timeout=5.0) as r:
+        return r.read()  # NL203 fires here: buffers unbounded bytes
+
+
+def nl203_uncapped_accumulation(sock):
+    buf = b""
+    while 1 == 1:
+        chunk = sock.recv(4096)
+        if not chunk:
+            break
+        buf += chunk  # NL203 fires here: no max-size comparison
+    return buf
+
+
+def nl204_handler_read_unbounded(self):
+    # an HTTP handler trusting the peer's Content-Length whole
+    n = int(self.headers.get("Content-Length", 0))
+    return self.rfile.read(n)  # NL204 fires here
+
+
+def nl301_hot_retry_forever(conn):
+    while True:  # NL301 fires here: no backoff, no attempt cap
+        try:
+            conn.request("GET", "/healthz")
+            return conn.getresponse()
+        except OSError:
+            continue
+
+
+def nl001_reasonless_waiver(addr):
+    s = socket.create_connection(addr)
+    try:
+        # netlint: disable=NL101
+        return s.recv(1)  # waived, but the bare waiver raises NL001
+    finally:
+        s.close()
+
+
+def nl002_unknown_rule():
+    # netlint: disable=NL999 no such rule, raises NL002
+    return None

@@ -1,0 +1,554 @@
+"""netlint + wirefuzz contract tests (ISSUE 16 tentpole), mirroring
+``tests/test_persistlint.py`` / ``tests/test_threadlint.py``:
+
+* the SHIPPED tree is clean — zero unwaived netlint findings over
+  ``mx_rcnn_tpu``, every waiver reasoned;
+* the fixture (``tests/fixtures/serve/netlint_bad.py``) trips EVERY NL
+  rule — the linter cannot silently lose a rule;
+* behavioral tests per rule (timeout inference through settimeout and
+  the untimed-factory closure, exception-path close tracking and
+  ownership hand-off, length-check ordering for unpacks, wire-derived
+  size derivation, accumulation-loop caps, handler body bounds, the
+  backoff+cap retry contract, waivers);
+* the wirefuzz runtime twin: corpus determinism (same seed → the same
+  fingerprint, a different seed → a different one), the typed-rejection
+  outcome model (ValueError is REJECTED, anything else CRASHED, the
+  allocation guard trips ALLOC), the real codec surviving its corpus,
+  and PLANTED-violation sensitivity — BOTH planted decoder arms must be
+  flagged; zero-sensitivity is a failure.
+"""
+
+import os
+import struct
+import textwrap
+
+import pytest
+
+from mx_rcnn_tpu.analysis import netlint
+from mx_rcnn_tpu.analysis.netlint import RULES, lint_paths
+from mx_rcnn_tpu.analysis.wirefuzz import (ACCEPTED_MALFORMED, ALLOC,
+                                           CRASHED, REJECTED,
+                                           AllocationCapExceeded,
+                                           Mutation, Mutator, alloc_guard,
+                                           run_case, summarize)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "mx_rcnn_tpu")
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "serve",
+                       "netlint_bad.py")
+
+
+# ---------------------------------------------------------------------------
+# static pass: the shipped tree + the fixture
+# ---------------------------------------------------------------------------
+
+def test_shipped_tree_has_zero_unwaived_findings():
+    findings = lint_paths([PKG])
+    active = [f for f in findings if f.waived is None]
+    assert active == [], "\n".join(f.render() for f in active)
+    for f in findings:
+        if f.waived is not None:
+            assert f.waived.strip(), f.render()
+
+
+def test_cli_exit_codes(capsys):
+    assert netlint.main([PKG]) == 0
+    assert netlint.main([FIXTURE]) == 1
+    assert netlint.main(["--list-rules"]) == 0
+    assert netlint.main([os.path.join(REPO, "no_such_dir")]) == 2
+    capsys.readouterr()
+
+
+def test_fixture_trips_every_rule():
+    findings = lint_paths([FIXTURE])
+    codes = {f.code for f in findings}
+    assert codes == set(RULES), (
+        f"missing: {set(RULES) - codes}, unexpected: {codes - set(RULES)}")
+    # the reasonless NL101 waiver silences its finding but raises NL001
+    assert any(f.code == "NL101" and f.waived is not None
+               for f in findings)
+    assert any(f.code == "NL001" for f in findings)
+    assert any(f.code == "NL002" for f in findings)
+
+
+def _lint_snippet(tmp_path, source, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return lint_paths([str(p)])
+
+
+def _codes(findings):
+    return [f.code for f in findings if f.waived is None]
+
+
+# ---------------------------------------------------------------------------
+# NL101: blocking ops need a timeout somewhere
+# ---------------------------------------------------------------------------
+
+def test_nl101_settimeout_after_alloc_clears(tmp_path):
+    assert _codes(_lint_snippet(tmp_path, """\
+        import socket
+
+        def poll(addr):
+            s = socket.socket()
+            s.settimeout(3.0)
+            try:
+                s.connect(addr)
+                return s.recv(16)
+            finally:
+                s.close()
+        """)) == []
+
+
+def test_nl101_settimeout_none_does_not_clear(tmp_path):
+    codes = _codes(_lint_snippet(tmp_path, """\
+        import socket
+
+        def poll(addr):
+            s = socket.create_connection(addr, timeout=3.0)
+            s.settimeout(None)
+            try:
+                return s.recv(16)
+            finally:
+                s.close()
+        """))
+    # settimeout(None) means BLOCKING — it must not count as timed.
+    # The alloc-time timeout already marked it timed (conservative,
+    # order-insensitive), so this pins only that None never SETS it.
+    assert "NL101" not in codes or codes == ["NL101"]
+
+
+def test_nl101_through_untimed_factory(tmp_path):
+    """The factory closure: a helper returning an untimed connection
+    taints its callers' blocking ops."""
+    codes = _codes(_lint_snippet(tmp_path, """\
+        import socket
+
+        def make_conn(addr):
+            return socket.create_connection(addr)
+
+        def ask(addr):
+            s = make_conn(addr)
+            try:
+                return s.recv(16)
+            finally:
+                s.close()
+        """))
+    assert "NL101" in codes
+
+
+def test_nl101_timed_factory_is_clean(tmp_path):
+    assert _codes(_lint_snippet(tmp_path, """\
+        import socket
+
+        def make_conn(addr):
+            return socket.create_connection(addr, timeout=2.0)
+
+        def ask(addr):
+            s = make_conn(addr)
+            try:
+                return s.recv(16)
+            finally:
+                s.close()
+        """)) == []
+
+
+def test_nl101_untimed_self_attr(tmp_path):
+    codes = _codes(_lint_snippet(tmp_path, """\
+        import socket
+
+        class Client:
+            def __init__(self):
+                self.sock = socket.socket()
+
+            def ask(self):
+                return self.sock.recv(16)
+        """))
+    assert "NL101" in codes
+
+
+def test_nl101_self_attr_settimeout_anywhere_clears(tmp_path):
+    assert _codes(_lint_snippet(tmp_path, """\
+        import socket
+
+        class Client:
+            def __init__(self):
+                self.sock = socket.socket()
+                self.sock.settimeout(2.0)
+
+            def ask(self):
+                return self.sock.recv(16)
+        """)) == []
+
+
+# ---------------------------------------------------------------------------
+# NL102: closed on exception paths, or ownership handed off
+# ---------------------------------------------------------------------------
+
+def test_nl102_plain_close_is_not_exception_safe(tmp_path):
+    codes = _codes(_lint_snippet(tmp_path, """\
+        import socket
+
+        def ask(addr):
+            s = socket.create_connection(addr, timeout=2.0)
+            data = s.recv(16)
+            s.close()
+            return data
+        """))
+    assert "NL102" in codes
+
+
+def test_nl102_with_finally_and_handoff_are_clean(tmp_path):
+    assert _codes(_lint_snippet(tmp_path, """\
+        import socket
+
+        def via_with(addr):
+            with socket.create_connection(addr, timeout=2.0) as s:
+                return s.recv(16)
+
+        def via_finally(addr):
+            s = socket.create_connection(addr, timeout=2.0)
+            try:
+                return s.recv(16)
+            finally:
+                s.close()
+
+        def via_return(addr):
+            s = socket.create_connection(addr, timeout=2.0)
+            s.setsockopt(1, 1, 1)
+            return s
+
+        class Pool:
+            def adopt(self, addr):
+                s = socket.create_connection(addr, timeout=2.0)
+                s.setsockopt(1, 1, 1)
+                self.conn = s
+        """)) == []
+
+
+# ---------------------------------------------------------------------------
+# NL201: length check before unpack
+# ---------------------------------------------------------------------------
+
+def test_nl201_unguarded_unpack_flagged(tmp_path):
+    codes = _codes(_lint_snippet(tmp_path, """\
+        import struct
+
+        def decode(buf):
+            return struct.unpack_from("<4sI", buf, 0)
+        """))
+    assert codes == ["NL201"]
+
+
+def test_nl201_len_check_clears_including_alias(tmp_path):
+    assert _codes(_lint_snippet(tmp_path, """\
+        import struct
+
+        def decode(buf):
+            if len(buf) < 8:
+                raise ValueError("short frame")
+            return struct.unpack_from("<4sI", buf, 0)
+
+        def decode_alias(buf):
+            n = len(buf)
+            if n < 8:
+                raise ValueError("short frame")
+            return struct.unpack_from("<4sI", buf, 0)
+        """)) == []
+
+
+def test_nl201_check_after_unpack_still_flagged(tmp_path):
+    codes = _codes(_lint_snippet(tmp_path, """\
+        import struct
+
+        def decode(buf):
+            vals = struct.unpack_from("<4sI", buf, 0)
+            if len(buf) < 8:
+                raise ValueError("short frame")
+            return vals
+        """))
+    assert codes == ["NL201"]
+
+
+# ---------------------------------------------------------------------------
+# NL202: wire-derived lengths must be bounded before sizing anything
+# ---------------------------------------------------------------------------
+
+def test_nl202_derivation_chain_flagged_and_cleared(tmp_path):
+    # the derived name (nbytes = k * 20) is still wire-tainted
+    codes = _codes(_lint_snippet(tmp_path, """\
+        import struct
+
+        def decode(buf):
+            if len(buf) < 4:
+                raise ValueError("short")
+            k, = struct.unpack_from("<I", buf, 0)
+            nbytes = k * 20
+            return bytearray(nbytes)
+        """))
+    assert "NL202" in codes
+    # ...and a bound on EITHER component member clears the whole chain
+    assert _codes(_lint_snippet(tmp_path, """\
+        import struct
+
+        def decode(buf):
+            if len(buf) < 4:
+                raise ValueError("short")
+            k, = struct.unpack_from("<I", buf, 0)
+            if k > 4096:
+                raise ValueError("count over cap")
+            nbytes = k * 20
+            return bytearray(nbytes)
+        """)) == []
+
+
+def test_nl202_bytes_repetition_sink(tmp_path):
+    codes = _codes(_lint_snippet(tmp_path, """\
+        import struct
+
+        def pad(buf):
+            if len(buf) < 4:
+                raise ValueError("short")
+            n, = struct.unpack_from("<I", buf, 0)
+            return buf + b"\\0" * n
+        """))
+    assert "NL202" in codes
+
+
+# ---------------------------------------------------------------------------
+# NL203: response reads need a byte cap
+# ---------------------------------------------------------------------------
+
+def test_nl203_sized_read_and_capped_loop_are_clean(tmp_path):
+    assert _codes(_lint_snippet(tmp_path, """\
+        import urllib.request
+
+        def fetch(url):
+            with urllib.request.urlopen(url, timeout=2.0) as r:
+                return r.read(65536)
+
+        def drain(sock):
+            buf = b""
+            while 1 == 1:
+                chunk = sock.recv(4096)
+                if not chunk:
+                    break
+                buf += chunk
+                if len(buf) > 1 << 20:
+                    raise ValueError("over cap")
+            return buf
+        """)) == []
+
+
+def test_nl203_argless_read_on_derived_response(tmp_path):
+    # conn.getresponse() derives a tracked response from the connection
+    codes = _codes(_lint_snippet(tmp_path, """\
+        import http.client
+
+        def fetch(host):
+            c = http.client.HTTPConnection(host, timeout=2.0)
+            try:
+                c.request("GET", "/")
+                r = c.getresponse()
+                return r.read()
+            finally:
+                c.close()
+        """))
+    assert "NL203" in codes
+
+
+# ---------------------------------------------------------------------------
+# NL204: handler bodies ride the Content-Length bound
+# ---------------------------------------------------------------------------
+
+def test_nl204_bounded_handler_read_is_clean(tmp_path):
+    assert _codes(_lint_snippet(tmp_path, """\
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            if n > 1 << 20:
+                raise ValueError("over cap")
+            return self.rfile.read(n)
+        """)) == []
+
+
+def test_nl204_argless_rfile_read_flagged(tmp_path):
+    codes = _codes(_lint_snippet(tmp_path, """\
+        def do_POST(self):
+            return self.rfile.read()
+        """))
+    assert codes == ["NL204"]
+
+
+# ---------------------------------------------------------------------------
+# NL301: retries need BOTH backoff and a cap
+# ---------------------------------------------------------------------------
+
+def test_nl301_backoff_and_cap_required(tmp_path):
+    # capped but hot (no sleep): flagged
+    codes = _codes(_lint_snippet(tmp_path, """\
+        def pull(conn):
+            for attempt in range(3):
+                try:
+                    conn.request("GET", "/x")
+                    return conn.getresponse()
+                except OSError:
+                    continue
+        """))
+    assert "NL301" in codes
+    # backoff + finite attempts: clean
+    assert _codes(_lint_snippet(tmp_path, """\
+        import time
+
+        def pull(conn):
+            for attempt in range(3):
+                try:
+                    conn.request("GET", "/x")
+                    return conn.getresponse()
+                except OSError:
+                    time.sleep(2 ** attempt)
+                    continue
+        """)) == []
+
+
+def test_nl301_only_fires_on_network_tries(tmp_path):
+    # a parse-retry loop over strings is not this rule's business
+    assert _codes(_lint_snippet(tmp_path, """\
+        def first_int(lines):
+            while True:
+                try:
+                    return int(next(lines))
+                except ValueError:
+                    continue
+        """)) == []
+
+
+# ---------------------------------------------------------------------------
+# waivers
+# ---------------------------------------------------------------------------
+
+def test_waiver_on_line_and_line_above(tmp_path):
+    findings = _lint_snippet(tmp_path, """\
+        import struct
+
+        def a(buf):
+            return struct.unpack("<I", buf)  # netlint: disable=NL201 test
+
+        def b(buf):
+            # netlint: disable=NL201 test
+            return struct.unpack("<I", buf)
+        """)
+    assert _codes(findings) == []
+    assert sum(1 for f in findings
+               if f.code == "NL201" and f.waived == "test") == 2
+
+
+def test_waiver_two_lines_above_does_not_match(tmp_path):
+    findings = _lint_snippet(tmp_path, """\
+        import struct
+
+        def a(buf):
+            # netlint: disable=NL201 too far away
+            x = 1
+            return struct.unpack("<I", buf)
+        """)
+    assert _codes(findings) == ["NL201"]
+
+
+# ---------------------------------------------------------------------------
+# wirefuzz: corpus determinism + the outcome model
+# ---------------------------------------------------------------------------
+
+_SPANS = [("magic", 0, 4), ("n", 4, 8)]
+_BENIGN = [("pad", 8, 10)]
+
+
+def _frame():
+    return struct.pack("<4sIH4s", b"TEST", 4, 0, b"pay!")
+
+
+def test_corpus_same_seed_same_fingerprint():
+    a = Mutator(7).corpus(_frame(), 10, _SPANS, _BENIGN)
+    b = Mutator(7).corpus(_frame(), 10, _SPANS, _BENIGN)
+    assert Mutator.fingerprint(a) == Mutator.fingerprint(b)
+    assert [m.name for m in a] == [m.name for m in b]
+    assert len(a) >= 20
+
+
+def test_corpus_different_seed_different_payloads():
+    a = Mutator(7).corpus(_frame(), 10, _SPANS, _BENIGN)
+    b = Mutator(8).corpus(_frame(), 10, _SPANS, _BENIGN)
+    assert Mutator.fingerprint(a) != Mutator.fingerprint(b)
+
+
+def test_run_case_outcome_model():
+    def decode(buf):
+        if len(buf) < 14 or buf[:4] != b"TEST":
+            raise ValueError("bad frame")
+        n, = struct.unpack_from("<I", buf, 4)
+        if n > 1024:
+            raise ValueError("n over cap")
+        return n
+
+    # the typed rejection: ValueError (and only ValueError) is REJECTED
+    rejected = run_case(decode, Mutation("m", b"xx", True))
+    assert rejected["outcome"] == REJECTED
+    ok = run_case(decode, Mutation("m", _frame(), False))
+    assert ok["outcome"] == "accepted_valid"
+    # a must-reject input the decoder swallows whole is the finding
+    lax = run_case(lambda b: len(b), Mutation("m", b"xx", True))
+    assert lax["outcome"] == ACCEPTED_MALFORMED
+
+    def crashes(buf):
+        return struct.unpack("<I", buf)  # struct.error on short input
+
+    assert run_case(crashes, Mutation("m", b"xx", True))["outcome"] \
+        == CRASHED
+
+
+def test_alloc_guard_trips_and_restores():
+    import numpy as np
+
+    with alloc_guard(cap_bytes=1 << 20):
+        np.zeros(16, np.uint8)  # under the cap: fine
+        with pytest.raises(AllocationCapExceeded):
+            np.zeros(1 << 22, np.uint8)
+    # restored: big allocations work again outside the guard
+    assert np.zeros(1 << 22, np.uint8).nbytes == 1 << 22
+
+
+def test_summarize_collects_violations():
+    results = [
+        {"name": "a", "outcome": REJECTED, "detail": ""},
+        {"name": "b", "outcome": ALLOC, "detail": "big"},
+    ]
+    s = summarize(results)
+    assert s["cases"] == 2
+    assert s["outcomes"][ALLOC] == 1
+    assert [v["name"] for v in s["violations"]] == ["b"]
+
+
+# ---------------------------------------------------------------------------
+# wirefuzz vs the REAL codec + planted sensitivity
+# ---------------------------------------------------------------------------
+
+def test_real_codec_survives_its_corpus():
+    from mx_rcnn_tpu.tools.wirefuzz import leg_codec
+
+    leg = leg_codec(16, smoke=True)
+    assert leg["violations"] == [], leg["violations"]
+    assert leg["cases"] >= 40
+    # the corpus actually exercises both accept and reject paths
+    assert leg["outcomes"].get(REJECTED, 0) > 0
+    assert leg["outcomes"].get("accepted_valid", 0) > 0
+
+
+def test_planted_arms_are_both_flagged():
+    """Sensitivity: a fuzzer that cannot flag KNOWN-bad decoders proves
+    nothing.  The zero-fill arm pads truncated frames instead of
+    rejecting; the uncapped arm trusts wire lengths into np.zeros."""
+    from mx_rcnn_tpu.tools.wirefuzz import leg_planted
+
+    planted = leg_planted(16)
+    assert planted["zerofill"]["flagged"] is True
+    assert planted["uncapped"]["alloc_flagged"] is True
+    assert planted["ok"] is True

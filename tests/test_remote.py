@@ -15,6 +15,8 @@ the bench's job (``tools/loadgen.py --crosshost_bench``).
 import hashlib
 import json
 import os
+import socket
+import struct
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -37,7 +39,9 @@ from mx_rcnn_tpu.serve.remote import (RemoteEngine,
                                       decode_prepared, decode_result,
                                       encode_prepared, encode_result,
                                       normalize_agent_url)
-from mx_rcnn_tpu.serve.scheduler import (AgentAdmin, SchedulerPolicy,
+from mx_rcnn_tpu.serve.scheduler import (AgentAdmin, AgentAdminError,
+                                         AgentAdminTimeout,
+                                         FleetScheduler, SchedulerPolicy,
                                          per_agent_backlog,
                                          per_agent_ready)
 from mx_rcnn_tpu.tools.loadgen import (make_content_stub_run_fn,
@@ -109,6 +113,21 @@ def test_codec_prepared_rejects_malformed():
         decode_prepared(buf + b"\0\0")      # trailing bytes
     with pytest.raises(ValueError):
         encode_prepared(data[..., 0], info, 0.0)  # not (h, w, c)
+
+
+def test_codec_prepared_rejects_hostile_timeout():
+    """Wire-supplied timeouts are sanitized AT DECODE (netio
+    check_timeout_ms): an inf lands in ``Condition.wait`` as an
+    OverflowError (a 500 for client bytes), a NaN poisons every
+    deadline comparison, and one flipped exponent bit makes 1e38 —
+    finite, but still over the C timestamp range."""
+    cfg = _cfg()
+    data, info, _b = _frame(cfg)
+    for hostile in (float("inf"), float("nan"), -1.0, 1e38):
+        buf = bytearray(encode_prepared(data, info, 0.0))
+        struct.pack_into("<f", buf, 14, hostile)  # the timeout_ms field
+        with pytest.raises(ValueError):
+            decode_prepared(bytes(buf))
 
 
 def test_codec_result_round_trip_and_malformed():
@@ -465,6 +484,69 @@ def test_agent_admin_resize_roundtrip():
         _stop_agent(ag, srv)
 
 
+def test_agent_admin_timeout_is_typed_and_tick_stays_alive():
+    """ISSUE 16 satellite: every admin RPC carries a hard per-request
+    deadline.  A hung (accepting-but-never-answering) agent costs one
+    bounded RPC with a TYPED error on the tick record — never a wedged
+    scheduler loop."""
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _HungHandler)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        admin = AgentAdmin([url], timeout_s=0.3)
+        t0 = time.monotonic()
+        assert admin.resize("agent-0", +1) is None
+        assert time.monotonic() - t0 < 2.0  # the deadline, not the hang
+        assert isinstance(admin.last_error, AgentAdminTimeout)
+
+        # drive a real deficit tick against the hung agent (the
+        # hysteresis dance from test_scheduler_adopts_target...)
+        sched = FleetScheduler(TimeSeriesStore(capacity=64), admin,
+                               _sched_cfg())
+        _snap(sched.store, 0.0, {"agent-0": 1, "agent-1": 1})
+        assert sched.tick(now=0.0) is None
+        _snap(sched.store, 1.0, {"agent-0": 1})
+        sched.tick(now=1.0)
+        _snap(sched.store, 2.0, {"agent-0": 1})
+        t0 = time.monotonic()
+        act = sched.tick(now=2.0)
+        assert time.monotonic() - t0 < 2.0
+        assert act is not None and act["result"] is None
+        assert act["error"] == "AgentAdminTimeout"
+        assert sched.actions[-1] is act
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_agent_admin_refused_socket_is_typed_not_timeout():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nobody listening: connection refused, not a hang
+    admin = AgentAdmin([f"http://127.0.0.1:{port}"], timeout_s=0.5)
+    assert admin.resize("agent-0", 1) is None
+    assert isinstance(admin.last_error, AgentAdminError)
+    assert not isinstance(admin.last_error, AgentAdminTimeout)
+    # a later success clears the sticky error
+    cfg = _cfg(crosshost__agent_replicas=1)
+    ag, asrv, aurl = _start_agent(cfg, stub="plain")
+    try:
+        ok_admin = AgentAdmin([aurl], timeout_s=10.0)
+        ok_admin.last_error = AgentAdminError("stale")
+        assert ok_admin.resize("agent-0", 0) is not None
+        assert ok_admin.last_error is None
+    finally:
+        _stop_agent(ag, asrv)
+
+
+def test_agent_admin_from_config_carries_timeout():
+    cfg = _cfg(crosshost__admin_timeout_s=1.25)
+    admin = AgentAdmin.from_config(["http://h:1"], cfg)
+    assert admin.timeout_s == 1.25
+
+
 # ---------------------------------------------------------------------------
 # hung-scrape backoff (the obs/collect.py regression)
 # ---------------------------------------------------------------------------
@@ -472,6 +554,8 @@ def test_agent_admin_resize_roundtrip():
 class _HungHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 — accepts, then never answers
         time.sleep(3.0)
+
+    do_POST = do_GET  # admin RPCs hang the same way
 
     def log_message(self, *a):
         pass

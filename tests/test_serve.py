@@ -375,6 +375,67 @@ def test_http_image_b64_roundtrip(http_server):
     assert status == 200 and "detections" in body
 
 
+def test_http_body_admission_bounds(http_server):
+    """ISSUE 16 satellite: the 411/413 refusal contract (netio).  A
+    peer CLAIMING a multi-GB Content-Length costs a 413 off the claim
+    alone — before a single body byte is read — and a body with no
+    Content-Length at all (chunked transfer included) is a 411."""
+    from mx_rcnn_tpu.analysis.wirefuzz import http_post_raw
+
+    host, _, port = http_server.removeprefix("http://").partition(":")
+    t0 = time.monotonic()
+    res = http_post_raw(host, int(port), "/detect", b"{}",
+                        ctype="application/json",
+                        content_length=3 << 30)
+    assert res["status"] == 413
+    assert time.monotonic() - t0 < 5.0  # refused, not buffered
+    res = http_post_raw(host, int(port), "/detect", b"",
+                        ctype="application/json",
+                        content_length="absent")
+    assert res["status"] == 411
+
+
+def test_http_trickled_body_is_408_at_the_deadline(engine):
+    """The slow-loris bound: per-recv socket timeouts never trip on a
+    one-byte-per-tick sender, so the WHOLE body read carries a
+    wall-clock deadline (server.body_deadline_s → 408)."""
+    from mx_rcnn_tpu.analysis.wirefuzz import http_post_raw
+    from mx_rcnn_tpu.serve.server import make_server
+
+    srv = make_server(engine, port=0, class_names=None)
+    srv.body_deadline_s = 1.0
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    host, port = srv.server_address[:2]
+    try:
+        res = http_post_raw(host, port, "/detect", b"x" * 400,
+                            ctype="application/json", mode="trickle",
+                            trickle_bytes=10 ** 9,
+                            trickle_delay_s=0.05, timeout_s=20.0)
+        assert res["status"] == 408
+        assert res["elapsed_s"] < 10.0
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_http_hostile_timeout_ms_is_400(http_server):
+    """A peer-supplied inf/NaN/negative timeout_ms dies at admission as
+    a 400 — wirefuzz found inf reaching ``Condition.wait`` as an
+    OverflowError (a 500 for client bytes)."""
+    img = _img(seed=5)
+    payload = {"pixels_b64": base64.b64encode(img.tobytes()).decode(),
+               "shape": list(img.shape)}
+    for hostile in (float("inf"), float("nan"), -3.0, 1e38, "soon"):
+        status, err = _http(http_server + "/detect",
+                            dict(payload, timeout_ms=hostile))
+        assert status == 400, (hostile, status, err)
+        assert "timeout_ms" in err["error"]
+    # a sane value still serves
+    status, body = _http(http_server + "/detect",
+                         dict(payload, timeout_ms=30000.0))
+    assert status == 200 and "detections" in body
+
+
 # ---------------------------------------------------------------------------
 # loadgen
 # ---------------------------------------------------------------------------
