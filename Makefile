@@ -16,7 +16,7 @@ NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 .PHONY: all native lint test test-all test-gate serve-smoke ft-smoke \
 	obs-smoke perf-smoke elastic-smoke data-smoke fleet-smoke \
 	quant-smoke threadlint-smoke bulk-smoke crashsim-smoke \
-	health-smoke crosshost-smoke wirefuzz-smoke clean
+	health-smoke crosshost-smoke wirefuzz-smoke sim-smoke clean
 
 all: native
 
@@ -211,6 +211,16 @@ threadlint-smoke:
 wirefuzz-smoke:
 	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.wirefuzz --smoke
 
+# fleet-simulator smoke (docs/SIM.md): the failure_storm scenario at
+# 100 hosts in virtual time — preemption sweep, crash-loop flappers
+# under the shipped RestartPolicy, deficit-driven re-placement, then a
+# demand ramp the re-placed fleet must absorb.  The SHIPPED
+# scheduler/health/JSQ stack runs the loop twice on the same seeded
+# trace; fails unless zero requests are lost AND the two decision logs
+# are byte-identical.  ~1 min, CPU-only.
+sim-smoke:
+	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.sim --smoke
+
 # elastic smoke (docs/FT.md "Elasticity"): a 2-process jax.distributed
 # CPU world loses one process to SIGTERM mid-epoch, shrinks onto the
 # survivor's device set (grad-accum rescaled so the global batch stays
@@ -237,9 +247,10 @@ elastic-smoke:
 # elastic shrink/grow storm (elastic-smoke, ~3 min), the
 # sanitizer-armed serve+elastic re-run (threadlint-smoke, ~4 min) and
 # the wire-protocol fuzz of the cross-host plane (wirefuzz-smoke, ~1 min)
-test-gate: lint crashsim-smoke wirefuzz-smoke serve-smoke perf-smoke \
-		obs-smoke health-smoke data-smoke fleet-smoke crosshost-smoke \
-		bulk-smoke quant-smoke ft-smoke elastic-smoke threadlint-smoke
+test-gate: lint crashsim-smoke wirefuzz-smoke sim-smoke serve-smoke \
+		perf-smoke obs-smoke health-smoke data-smoke fleet-smoke \
+		crosshost-smoke bulk-smoke quant-smoke ft-smoke elastic-smoke \
+		threadlint-smoke
 	python -m pytest tests/ -x -q -m "gate"
 
 clean:

@@ -705,6 +705,43 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class SimConfig:
+    """TPU addition (no reference equivalent): policy knobs for the
+    ``mx_rcnn_tpu/sim/`` fleet-at-scale simulator (docs/SIM.md) — a
+    discrete-event virtual-time harness that runs the SHIPPED
+    scheduler/health/router decision code over hundreds of simulated
+    hosts.  Request-level semantics (batch size, shed watermark,
+    deadline) are deliberately NOT duplicated here: the simulator reads
+    ``cfg.serve`` and ``cfg.crosshost`` so a policy is gauntleted under
+    the exact knobs it ships with.
+
+    Same 3-level precedence as every section (hardcoded defaults <
+    presets < ``--set sim__field=value`` CLI overrides).
+    """
+
+    hosts: int = 100            # simulated agent hosts (one registry each)
+    duration_s: float = 240.0   # trace length in VIRTUAL seconds
+    seed: int = 0               # root seed for every sim RNG substream
+    # collector scrape / health / scheduler cadence in virtual seconds
+    # (the sim analog of crosshost.scrape_interval_s, which is tuned for
+    # wall-clock HTTP scraping and would be needless event pressure here)
+    scrape_interval_s: float = 1.0
+    # per-dispatch service time at the SMALLEST bucket (ms).  The engine
+    # pads every micro-batch to serve.batch_size rows, so service cost
+    # depends on the bucket, not the occupancy — 430 ms/batch-of-4
+    # reproduces the ~9.3 img/s per-host rate CROSSHOST_r15 measured.
+    # Larger buckets scale by pixel ratio.
+    service_ms: float = 430.0
+    service_jitter: float = 0.10   # lognormal sigma on service draws
+    warmup_s: float = 5.0          # resize(+1) cold-join delay (vt)
+    relaunch_s: float = 8.0        # host drain->relaunch dark time (vt)
+    util: float = 0.65             # generators' base demand, as a
+                                   # fraction of boot fleet capacity
+    settle_s: float = 60.0         # post-trace drain budget before any
+                                   # still-queued request counts lost
+
+
+@dataclass(frozen=True)
 class Config:
     train: TrainConfig = field(default_factory=TrainConfig)
     test: TestConfig = field(default_factory=TestConfig)
@@ -721,6 +758,7 @@ class Config:
     obs: ObsConfig = field(default_factory=ObsConfig)
     elastic: ElasticConfig = field(default_factory=ElasticConfig)
     quant: QuantConfig = field(default_factory=QuantConfig)
+    sim: SimConfig = field(default_factory=SimConfig)
 
     @property
     def num_classes(self) -> int:

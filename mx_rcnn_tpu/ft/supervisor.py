@@ -65,13 +65,19 @@ class RestartPolicy:
 
     def __init__(self, base_s: float = 0.25, factor: float = 2.0,
                  cap_s: float = 30.0, jitter_frac: float = 0.25,
-                 give_up_after: int = 4, seed: int = 0, registry=None):
+                 give_up_after: int = 4, seed: int = 0, registry=None,
+                 clock=time.monotonic):
         self.base_s = base_s
         self.factor = factor
         self.cap_s = cap_s
         self.jitter_frac = jitter_frac
         self.give_up_after = give_up_after
         self.seed = seed
+        # restart-instant clock: monotonic by default, virtual under
+        # sim/; record() stamps ready_at = clock() + backoff so callers
+        # that schedule (rather than sleep) share one time base
+        self._clock = clock
+        self.ready_at: float = float("-inf")
         self.failures = 0          # consecutive no-progress failures
         self.identical = 0         # consecutive IDENTICAL failures
         self._last_sig: Optional[tuple] = None
@@ -122,6 +128,7 @@ class RestartPolicy:
                 self._last_sig = signature
             give_up = self.identical >= self.give_up_after
             delay = self.delay_s()
+            self.ready_at = self._clock() + delay
             failures, identical = self.failures, self.identical
         self._rec.set_gauge("ft.supervisor.backoff_s", delay)
         self._rec.set_gauge("ft.supervisor.consecutive_failures", failures)

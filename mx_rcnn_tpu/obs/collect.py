@@ -185,9 +185,12 @@ class Collector:
     — a down source is data (``up: false``), not an exception.
     """
 
-    def __init__(self, sources: Optional[List] = None):
+    def __init__(self, sources: Optional[List] = None,
+                 clock: Callable[[], float] = time.time):
         self._lock = threading.Lock()
         self._sources: Dict[str, object] = {}
+        # view-timestamp clock: wall time by default, virtual under sim/
+        self._clock = clock
         for s in sources or []:
             self._sources[s.name] = s
 
@@ -206,7 +209,7 @@ class Collector:
     def collect(self) -> Dict:
         with self._lock:
             sources = list(self._sources.values())
-        view: Dict = {"ts": round(time.time(), 6), "sources": {}}
+        view: Dict = {"ts": round(self._clock(), 6), "sources": {}}
         agg_counters: Dict[str, float] = {}
         agg_gauges: Dict[str, Dict[str, float]] = {}
         up = 0

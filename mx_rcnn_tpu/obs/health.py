@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
@@ -82,7 +83,12 @@ class Rule:
     def value(self, store: TimeSeriesStore) -> Optional[float]:
         k = self.kind
         if k == "gauge":
-            return store.gauge(self.metric)
+            # Bounded by the rule's window: a gauge whose source died
+            # longer than window_s ago reads None (absent), the same
+            # judgment as a source that never reported at all.  The
+            # unbounded scan used to return the dead source's stale
+            # last value forever — a down host read as healthy.
+            return store.gauge(self.metric, self.window_s)
         if k == "gauge_min":
             return store.gauge_min(self.metric, self.window_s)
         if k == "gauge_max":
@@ -124,12 +130,16 @@ class HealthEngine:
     def __init__(self, rules: List[Rule], store: TimeSeriesStore,
                  registry=None, record=None,
                  on_transition: Optional[Callable[[str, str, Dict],
-                                                  None]] = None):
+                                                  None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
         self.rules = list(rules)
         self.store = store
         self._reg = registry
         self._record = record
         self._on_transition = on_transition
+        # engine clock: monotonic by default, virtual under the
+        # simulator; stamps every verdict (SLO-minute attribution)
+        self._clock = clock
         self._lock = threading.Lock()
         self._state = {r.name: _RuleState() for r in self.rules}
         self._verdict = OK
@@ -183,6 +193,7 @@ class HealthEngine:
             self._verdict = worst
             verdict = {"verdict": worst, "code": _LEVEL[worst],
                        "previous": prev, "changed": worst != prev,
+                       "ts": round(float(self._clock()), 6),
                        "firing": fired, "rules": rule_out}
             self._last = verdict
         self._publish(verdict)

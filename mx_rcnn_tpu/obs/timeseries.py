@@ -62,8 +62,10 @@ class TimeSeriesStore:
     sample — a few KB for this repo's metric surface.
     """
 
-    def __init__(self, capacity: int = 600):
+    def __init__(self, capacity: int = 600,
+                 clock: Callable[[], float] = time.time):
         self.capacity = int(capacity)
+        self._clock = clock
         self._lock = threading.Lock()
         self._buf: deque = deque(maxlen=self.capacity)
         self._dropped = 0
@@ -80,7 +82,7 @@ class TimeSeriesStore:
             from mx_rcnn_tpu.obs.metrics import registry as _registry
 
             reg = _registry()
-        ts = time.time() if ts is None else ts
+        ts = self._clock() if ts is None else ts
         with reg.lock:
             counters = dict(reg._counters)
             gauges = dict(reg._gauges)
@@ -105,7 +107,7 @@ class TimeSeriesStore:
         uses.  Histograms arrive as summaries (no bucket counts), so
         windowed percentiles over these samples fall back to the latest
         summary value."""
-        smp = {"ts": time.time() if ts is None else ts,
+        smp = {"ts": self._clock() if ts is None else ts,
                "counters": dict(snap.get("counters", {})),
                "gauges": dict(snap.get("gauges", {})),
                "hists": {name: {"summary": dict(s)}
@@ -180,12 +182,14 @@ class TimeSeriesStore:
             return None
         return d / span
 
-    def gauge(self, name: str) -> Optional[float]:
+    def gauge(self, name: str,
+              window_s: Optional[float] = None) -> Optional[float]:
         """Most recent value of the gauge (scanning back for the last
-        sample that carried it)."""
-        with self._lock:
-            buf = list(self._buf)
-        for smp in reversed(buf):
+        sample that carried it).  ``window_s`` bounds the scan: a gauge
+        whose source stopped reporting longer than the window ago reads
+        None — exactly like a source that NEVER reported.  Unbounded
+        (the default) preserves the original latest-ever semantics."""
+        for smp in reversed(self.window(window_s)):
             if name in smp["gauges"]:
                 return float(smp["gauges"][name])
         return None
@@ -314,11 +318,15 @@ class Sampler:
 
     def __init__(self, store: TimeSeriesStore, interval_s: float = 1.0,
                  reg=None,
-                 after_sample: Optional[Callable[[Dict], None]] = None):
+                 after_sample: Optional[Callable[[Dict], None]] = None,
+                 clock: Optional[Callable[[], float]] = None):
         self.store = store
         self.interval_s = max(float(interval_s), 0.01)
         self._reg = reg
         self._after = after_sample
+        # sample timestamp source; None defers to the store's clock
+        # (wall time by default, virtual time under the simulator)
+        self._clock = clock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -326,7 +334,8 @@ class Sampler:
         """One sample + hook pass (public so tests drive the cadence
         deterministically without the wall-clock loop — the same
         pattern as ``ReplicaManager.tick``)."""
-        smp = self.store.sample(self._reg)
+        ts = None if self._clock is None else self._clock()
+        smp = self.store.sample(self._reg, ts=ts)
         if self._after is not None:
             try:
                 self._after(smp)

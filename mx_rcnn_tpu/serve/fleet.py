@@ -64,6 +64,20 @@ R_RELAUNCHING = "relaunching"
 R_DEAD = "dead"          # crash-loop verdict or relaunch disabled
 
 
+def jsq_key(lane_depth: int, total_depth: int, rid: int, rot: int,
+            n_cands: int, batch: int) -> Tuple[int, int, int]:
+    """Batch-aware JSQ sort key — pick the candidate with the SMALLEST.
+
+    Primary is ``ceil((lane_depth + 1) / batch)``: how many dispatch
+    cycles until a request appended to this candidate's bucket lane
+    would serve, so same-bucket traffic packs full batches and spreads
+    lanes evenly.  Total in-flight depth breaks cycle ties, a rotating
+    index breaks those.  Pure (no replica objects) so the fleet-scale
+    simulator routes with the SHIPPED decision logic, not a copy."""
+    cycles = -(-(int(lane_depth) + 1) // int(batch))
+    return (cycles, int(total_depth), (int(rid) + int(rot)) % int(n_cands))
+
+
 class FleetMetrics(ServeMetrics):
     """Fleet-level request accounting: same counters / histograms /
     snapshot format as :class:`ServeMetrics` (so ``serve/server.py`` and
@@ -546,8 +560,8 @@ class FleetRouter:
                 eng = r.engine if r.state == R_READY else None
             if eng is None:
                 return (float("inf"), float("inf"), 0)
-            cycles = -(-(eng.bucket_depth(bucket) + 1) // batch)
-            return (cycles, r.depth(), (r.id + rot) % len(cands))
+            return jsq_key(eng.bucket_depth(bucket), r.depth(), r.id,
+                           rot, len(cands), batch)
 
         target = min(cands, key=_score)
         freq.tried.add(target.id)

@@ -108,9 +108,11 @@ class SchedulerPolicy:
     <agent source name>, "reason": ..., "ready": ..., "target": ...}``.
     """
 
-    def __init__(self, cfg: Config):
+    def __init__(self, cfg: Config, clock=time.monotonic):
         ch = cfg.crosshost
         self.cfg = cfg
+        # cooldown clock: monotonic by default, virtual under sim/
+        self._clock = clock
         # 0 = adopt whatever capacity the fleet reports on the first
         # tick that sees a ready replica (hosts x agent_replicas at a
         # clean boot) — the operator states intent by exception only
@@ -152,7 +154,7 @@ class SchedulerPolicy:
 
     def decide(self, store: TimeSeriesStore,
                now: float = None) -> Optional[Dict]:
-        now = time.monotonic() if now is None else now
+        now = self._clock() if now is None else now
         sample = _latest(store)
         if sample is None:
             return None
@@ -318,8 +320,8 @@ class FleetScheduler:
     ``crosshost.interval_s``."""
 
     def __init__(self, store: TimeSeriesStore, admin: AgentAdmin,
-                 cfg: Config, record=None):
-        self.policy = SchedulerPolicy(cfg)
+                 cfg: Config, record=None, clock=time.monotonic):
+        self.policy = SchedulerPolicy(cfg, clock=clock)
         self.store = store
         self.admin = admin
         self.cfg = cfg

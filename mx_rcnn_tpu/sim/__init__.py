@@ -1,0 +1,20 @@
+"""Fleet-at-scale simulator: the control plane judged at 100 hosts.
+
+No reference equivalent.  The scheduler, health engine and JSQ router
+(PRs 14-15) have only ever been exercised at 2-4 hosts on a 1-core box;
+the north star is a fleet.  This package answers "does hysteresis flap
+at 100 hosts?" without silicon: a discrete-event virtual-time kernel
+(``kernel.py``) drives simulated hosts/replicas that emulate the agent
+plane's gauge surface (``cluster.py``), scenario trace generators
+(``traffic.py``) shape demand and failures, and the harness
+(``control.py``) runs the SHIPPED ``SchedulerPolicy`` / ``HealthEngine``
+/ ``jsq_key`` / ``RestartPolicy`` decision code — the real classes, fed
+through the real ``Collector`` → ``TimeSeriesStore`` path, on a virtual
+clock — while ``score.py`` turns the outcome into lost requests,
+SLO-minutes breached and capacity-seconds wasted.
+
+``python -m mx_rcnn_tpu.tools.sim`` is the policy gauntlet driver
+(``SIM_r17.json``); ``docs/SIM.md`` is the manual.
+"""
+
+from mx_rcnn_tpu.sim.kernel import SimKernel, VirtualClock  # noqa: F401

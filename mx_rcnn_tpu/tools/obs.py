@@ -122,9 +122,12 @@ def run_check(collector: Collector, cfg, samples: int,
     engine = HealthEngine(default_rules(cfg), store)
     view = None
     up = 0
+    ever_up: set = set()
     for i in range(samples):
         view = collector.collect()
         up = view["up"]
+        ever_up.update(name for name, src in view["sources"].items()
+                       if src.get("up"))
         store.append_snapshot(view_to_snapshot(view), ts=view["ts"])
         engine.evaluate()
         if i < samples - 1:
@@ -133,6 +136,11 @@ def run_check(collector: Collector, cfg, samples: int,
                                      "rules": [], "firing": []})
     verdict["sources_up"] = up
     verdict["samples"] = samples
+    # a configured source that answered NO scrape the whole check: it
+    # contributed zero samples, so every gauge-backed judgment treats
+    # it exactly like a downed one (absent, not stale) — but the
+    # operator should see the distinction spelled out
+    verdict["never_up"] = sorted(set(collector.names()) - ever_up)
     if view is not None:
         verdict["view"] = {
             name: ({"up": src.get("up", False),

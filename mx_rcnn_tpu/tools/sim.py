@@ -1,0 +1,274 @@
+"""Policy gauntlet: the shipped control plane vs. the fleet simulator.
+
+Runs every scenario in ``sim/traffic.py`` through two arms of the same
+harness (``sim/control.py``):
+
+* **shipped** — the production ``SchedulerPolicy`` / ``HealthEngine`` /
+  JSQ-router tuning, exactly as configured by ``generate_config``;
+* **mistuned** — the same code with the red-team knob set
+  (``MISTUNED_OVERRIDES``): blind to deficit and overload, zero drain
+  hysteresis, drain floor inverted to one replica fleet-wide.
+
+then re-runs one shipped arm to pin determinism (byte-identical
+decision log + equal score for the same trace + seed).
+
+``--check`` is the acceptance gate: shipped loses ZERO requests on
+every trace, the mistuned arm measurably breaches (lost > 0 or
+CRITICAL SLO-minutes > 0) on at least one scenario where shipped does
+neither, and the determinism re-run matched.  ``--smoke`` is the
+``make sim-smoke`` shape: one scenario (failure_storm — the richest:
+preemptions, crash-loop supervision, deficit re-placement), shipped
+arm twice, same assertions, sized for the test gate.
+
+Usage::
+
+    python -m mx_rcnn_tpu.tools.sim [--scenario all] [--hosts 100]
+                                    [--seed 0] [--out SIM_r17.json]
+                                    [--check] [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.sim.control import MISTUNED_OVERRIDES, SimRun
+from mx_rcnn_tpu.sim.score import decision_log_bytes
+from mx_rcnn_tpu.sim.traffic import SCENARIOS, generate
+
+# the scenario whose shipped arm is re-run for the determinism pin and
+# which `--smoke` exercises: failure_storm drives every subsystem at
+# once (preemption, crash-loop supervision, deficit re-placement,
+# reroute, expiry pressure)
+PIN_SCENARIO = "failure_storm"
+
+
+def _arm(trace: Dict, cfg, label: str,
+         overrides: Optional[Dict] = None) -> Dict:
+    t0 = time.perf_counter()
+    run = SimRun(trace, cfg, label=label, arm_overrides=overrides)
+    logger = logging.getLogger("mx_rcnn_tpu")
+    level = logger.level
+    logger.setLevel(logging.ERROR)  # per-event health/supervisor chatter
+    try:                            # — thousands of lines at fleet scale
+        score = run.run()
+    finally:
+        logger.setLevel(level)
+    score["wall_s"] = round(time.perf_counter() - t0, 2)
+    return score
+
+
+def _atomic_json(path: str, record: Dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
+def _fmt(name: str, s: Dict) -> str:
+    return (f"  {name:>14}/{s['label']:<8} lost={s['lost']:5d} "
+            f"(exp {s['expired']}, fail {s['failed']}) "
+            f"shed={s['shed']:5d} served={s['served']:6d} "
+            f"crit_min={s['slo_critical_minutes']:7.3f} "
+            f"waste_rs={s['capacity_wasted_replica_s']:9.1f} "
+            f"acts={s['actions']:3d} [{s['wall_s']}s]")
+
+
+def run_gauntlet(scenarios: List[str], hosts: int, seed: int,
+                 duration_s: Optional[float] = None) -> Dict:
+    """All requested scenarios x both arms + the determinism pin."""
+    overrides = {} if duration_s is None else {"sim__duration_s":
+                                               float(duration_s)}
+    cfg = generate_config("tiny", "synthetic", **overrides)
+    out: Dict = {"scenarios": {}}
+    for name in scenarios:
+        trace = generate(name, cfg, hosts, seed)
+        shipped = _arm(trace, cfg, "shipped")
+        mistuned = _arm(trace, cfg, "mistuned", MISTUNED_OVERRIDES)
+        out["scenarios"][name] = {
+            "trace_fingerprint": trace["fingerprint"],
+            "hosts": trace["hosts"],
+            "duration_s": trace["duration_s"],
+            "seed": trace["seed"],
+            "arms": {"shipped": shipped, "mistuned": mistuned},
+        }
+        print(_fmt(name, shipped), flush=True)
+        print(_fmt(name, mistuned), flush=True)
+    # determinism pin: same trace + seed must reproduce the same bytes
+    pin = PIN_SCENARIO if PIN_SCENARIO in scenarios else scenarios[0]
+    trace = generate(pin, cfg, hosts, seed)
+    rerun = _arm(trace, cfg, "shipped")
+    first = out["scenarios"][pin]["arms"]["shipped"]
+    out["determinism"] = {
+        "scenario": pin,
+        "sha_first": first["decision_log_sha256"],
+        "sha_rerun": rerun["decision_log_sha256"],
+        "log_identical": (first["decision_log_sha256"]
+                          == rerun["decision_log_sha256"]),
+        "score_identical": all(
+            first[k] == rerun[k] for k in first if k != "wall_s"),
+    }
+    return out
+
+
+def check_gauntlet(record: Dict) -> List[str]:
+    """The acceptance predicate — empty list means the gate holds."""
+    problems: List[str] = []
+    scen = record["scenarios"]
+    if not scen:
+        return ["no scenarios ran"]
+    breach = 0
+    for name, s in sorted(scen.items()):
+        shipped = s["arms"]["shipped"]
+        mistuned = s["arms"]["mistuned"]
+        if s["hosts"] < 100:
+            problems.append(f"{name}: only {s['hosts']} hosts — the "
+                            "acceptance gate requires >= 100")
+        if shipped["lost"] != 0:
+            problems.append(
+                f"{name}: shipped policy LOST {shipped['lost']} "
+                f"requests (expired {shipped['expired']}, failed "
+                f"{shipped['failed']}) — must be 0")
+        shipped_clean = (shipped["lost"] == 0
+                         and shipped["slo_critical_minutes"] == 0)
+        mistuned_breached = (mistuned["lost"] > 0
+                             or mistuned["slo_critical_minutes"] > 0)
+        if shipped_clean and mistuned_breached:
+            breach += 1
+    if breach == 0:
+        problems.append(
+            "mistuned arm never breached where shipped was clean — "
+            "the gauntlet has zero discrimination")
+    det = record.get("determinism") or {}
+    if not det.get("log_identical"):
+        problems.append("determinism: decision logs differ between "
+                        "identical runs")
+    if not det.get("score_identical"):
+        problems.append("determinism: scores differ between identical "
+                        "runs")
+    return problems
+
+
+def run_smoke(hosts: int, seed: int) -> int:
+    """make sim-smoke: one shipped failure_storm arm, twice; asserts
+    zero lost + byte-identical decision log.  No file written."""
+    cfg = generate_config("tiny", "synthetic")
+    trace = generate(PIN_SCENARIO, cfg, hosts, seed)
+    logging.getLogger("mx_rcnn_tpu").setLevel(logging.ERROR)
+    runs = []
+    for i in (1, 2):
+        t0 = time.perf_counter()
+        run = SimRun(trace, cfg, label="shipped")
+        score = run.run()
+        print(f"sim-smoke: run {i}: lost={score['lost']} "
+              f"served={score['served']} actions={score['actions']} "
+              f"sha={score['decision_log_sha256'][:16]} "
+              f"[{time.perf_counter() - t0:.1f}s]", flush=True)
+        runs.append((score, decision_log_bytes(run.log)))
+    (s1, b1), (s2, b2) = runs
+    problems = []
+    if s1["lost"] != 0:
+        problems.append(f"shipped policy lost {s1['lost']} requests "
+                        f"on {PIN_SCENARIO}")
+    if b1 != b2:
+        problems.append("decision logs are not byte-identical")
+    if {k: v for k, v in s1.items() if k != "wall_s"} != \
+            {k: v for k, v in s2.items() if k != "wall_s"}:
+        problems.append("scores differ between identical runs")
+    if problems:
+        for pr in problems:
+            print(f"SIM SMOKE FAILED: {pr}", file=sys.stderr)
+        return 1
+    print(f"SIM SMOKE OK: {PIN_SCENARIO} x {hosts} hosts, "
+          f"{s1['submitted']} requests, 0 lost, byte-identical "
+          "decision log across runs")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="sim",
+        description="fleet-at-scale policy gauntlet in virtual time "
+                    "(docs/SIM.md)")
+    p.add_argument("--scenario", default="all",
+                   choices=list(SCENARIOS) + ["all"],
+                   help="one scenario, or 'all' (default)")
+    p.add_argument("--hosts", type=int, default=0,
+                   help="fleet size (0 = config sim.hosts, 100)")
+    p.add_argument("--seed", type=int, default=-1,
+                   help="trace seed (-1 = config sim.seed, 0)")
+    p.add_argument("--duration_s", type=float, default=0.0,
+                   help="trace length override (0 = config default)")
+    p.add_argument("--out", default="SIM_r17.json")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 unless shipped loses 0 everywhere, "
+                        "mistuned breaches somewhere, and reruns are "
+                        "byte-identical")
+    p.add_argument("--smoke", action="store_true",
+                   help="gate-scale run: one scenario, shipped arm "
+                        "twice, determinism + zero-lost asserted")
+    args = p.parse_args(argv)
+    cfg = generate_config("tiny", "synthetic")
+    hosts = args.hosts or cfg.sim.hosts
+    seed = args.seed if args.seed >= 0 else cfg.sim.seed
+
+    if args.smoke:
+        return run_smoke(hosts, seed)
+
+    scenarios = (list(SCENARIOS) if args.scenario == "all"
+                 else [args.scenario])
+    print(f"sim gauntlet: {len(scenarios)} scenario(s) x "
+          f"{hosts} hosts, seed {seed}", flush=True)
+    result = run_gauntlet(scenarios, hosts, seed,
+                          args.duration_s or None)
+    problems = check_gauntlet(result)
+    worst = max(s["arms"]["shipped"]["lost"]
+                for s in result["scenarios"].values())
+    record = {
+        "metric": "sim_gauntlet_shipped_lost_requests",
+        "value": worst,
+        "unit": "requests",
+        "measured": True,
+        "hosts": hosts,
+        "seed": seed,
+        "scenarios": result["scenarios"],
+        "determinism": result["determinism"],
+        "check": {"problems": problems, "ok": not problems},
+    }
+    _atomic_json(args.out, record)
+    print(f"sim: record -> {args.out}", flush=True)
+    if args.check:
+        if problems:
+            for pr in problems:
+                print(f"SIM CHECK FAILED: {pr}", file=sys.stderr)
+            return 1
+        n = len(result["scenarios"])
+        print(f"SIM CHECK OK: shipped lost 0 on all {n} scenario(s); "
+              "mistuned arm measurably breached; decision log "
+              "byte-identical across identical runs")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
