@@ -16,7 +16,8 @@ NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 .PHONY: all native lint test test-all test-gate serve-smoke ft-smoke \
 	obs-smoke perf-smoke elastic-smoke data-smoke fleet-smoke \
 	quant-smoke threadlint-smoke bulk-smoke crashsim-smoke \
-	health-smoke crosshost-smoke wirefuzz-smoke sim-smoke clean
+	health-smoke crosshost-smoke wirefuzz-smoke sim-smoke \
+	rollout-smoke clean
 
 all: native
 
@@ -221,6 +222,18 @@ wirefuzz-smoke:
 sim-smoke:
 	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.sim --smoke
 
+# rollout smoke (docs/SERVING.md "Rollout tier"): lineage truth table
+# (unknown-parent / unrooted / fingerprint-mismatch refusals, legacy
+# version-less back-compat), then a 2-host LIVE mid-burst v1->v2 swap
+# through pull -> canary (online paired gate) -> rolling -> finalize —
+# fails unless 0 requests lost, one transfer per host, and a post-swap
+# mixed-bucket burst lowers ZERO new programs — then a red-team arm: a
+# lineage-genuine store with DAMAGED bundled weights that the gate must
+# refuse and auto-rollback to base-only, again 0 lost.  ~2 min.
+rollout-smoke:
+	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.rollout \
+		--smoke --check
+
 # elastic smoke (docs/FT.md "Elasticity"): a 2-process jax.distributed
 # CPU world loses one process to SIGTERM mid-epoch, shrinks onto the
 # survivor's device set (grad-accum rescaled so the global batch stays
@@ -250,7 +263,7 @@ elastic-smoke:
 test-gate: lint crashsim-smoke wirefuzz-smoke sim-smoke serve-smoke \
 		perf-smoke obs-smoke health-smoke data-smoke fleet-smoke \
 		crosshost-smoke bulk-smoke quant-smoke ft-smoke elastic-smoke \
-		threadlint-smoke
+		rollout-smoke threadlint-smoke
 	python -m pytest tests/ -x -q -m "gate"
 
 clean:

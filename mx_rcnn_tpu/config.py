@@ -742,6 +742,51 @@ class SimConfig:
 
 
 @dataclass(frozen=True)
+class RolloutConfig:
+    """TPU addition (no reference equivalent): knobs for the live-ops
+    rollout plane (``serve/rollout.py``) — versioned export stores,
+    per-host rolling updates, canary routing with the online paired
+    gate, and first-class rollback (docs/SERVING.md "Rollout tier").
+
+    Same 3-level precedence as every section (hardcoded defaults <
+    presets < ``--set rollout__field=value`` CLI overrides).
+    """
+
+    # fraction of traffic the JSQ router sends down the canary version
+    # lane while the gate observes (deterministic fraction accumulator,
+    # not a coin flip — byte-reproducible under the simulator)
+    canary_fraction: float = 0.25
+    # online paired gate: equivalence budget on the shadow-score scale
+    # (same CI-inside-±budget TOST judgment as tools/gauntlet.py
+    # paired_compare) and the minimum paired samples before judging
+    gate_budget: float = 0.02
+    gate_min_pairs: int = 12
+    # shadow-score every Nth sampled canary opportunity (live: every Nth
+    # controller tick; sim: every Nth virtual gate tick)
+    gate_sample_every: int = 4
+    # canary dwell before rolling: the gate and HealthEngine observe at
+    # least this long even if min_pairs is reached earlier
+    bake_s: float = 10.0
+    # per-host swap step bound: a host that stops answering mid-step is
+    # skipped after this long and re-checked during FINALIZE (the
+    # kill-mid-rollout convergence path)
+    step_timeout_s: float = 60.0
+    # hosts rolled concurrently (the wave width).  1 = strictly serial
+    # per-host rolling (the live default); the 100-host sim scenario
+    # overrides this to a wave, as a real fleet runbook would
+    wave: int = 1
+    # cadence of controller re-checks while waiting on pulls / warms /
+    # drains (virtual seconds under the sim, wall seconds live)
+    settle_s: float = 1.0
+    # simulated store-pull latency (virtual seconds) for the sim port
+    pull_s: float = 3.0
+    # red-team arm: deterministic shadow-score damage applied to the v2
+    # arm (sim + bench only; 0.0 = healthy).  The online-gate analog of
+    # the gauntlet's _REDTEAM_NMS damaged arm.
+    redteam_damage: float = 0.0
+
+
+@dataclass(frozen=True)
 class Config:
     train: TrainConfig = field(default_factory=TrainConfig)
     test: TestConfig = field(default_factory=TestConfig)
@@ -759,6 +804,7 @@ class Config:
     elastic: ElasticConfig = field(default_factory=ElasticConfig)
     quant: QuantConfig = field(default_factory=QuantConfig)
     sim: SimConfig = field(default_factory=SimConfig)
+    rollout: RolloutConfig = field(default_factory=RolloutConfig)
 
     @property
     def num_classes(self) -> int:

@@ -61,6 +61,7 @@ from __future__ import annotations
 import json
 import logging
 import signal
+import threading
 import time
 from typing import Callable, NamedTuple, Optional
 
@@ -199,6 +200,10 @@ class ElasticController:
         self._poll_now = False
         self._applied: Optional[Topology] = None
         self._pending: Optional[Topology] = None
+        # poll() may run off-thread (a serving agent's admin surface
+        # driving the directive check) while the training loop calls
+        # mark_applied(); one lock covers the applied/pending pair
+        self._topo_lock = threading.Lock()
         self._steps_since_poll = 0
         from mx_rcnn_tpu.obs.metrics import registry
 
@@ -221,8 +226,9 @@ class ElasticController:
         return self._applied
 
     def mark_applied(self, topo: Topology) -> None:
-        self._applied = topo
-        self._pending = None
+        with self._topo_lock:
+            self._applied = topo
+            self._pending = None
         self._rec.set_gauge("elastic.generation", topo.generation)
         self._rec.set_gauge("elastic.num_devices", topo.num_devices)
         self._rec.set_gauge("elastic.num_processes", topo.num_processes)
@@ -236,11 +242,12 @@ class ElasticController:
         """Read the directive file now; returns (and caches) a directive
         newer than the applied topology, else None."""
         directive = read_topology(self.path)
-        if directive is not None and (
-                self._applied is None
-                or directive.generation > self._applied.generation):
-            self._pending = directive
-        return self._pending
+        with self._topo_lock:
+            if directive is not None and (
+                    self._applied is None
+                    or directive.generation > self._applied.generation):
+                self._pending = directive
+            return self._pending
 
     def resize_requested(self) -> bool:
         """Per-step check (the fit stop-flag hook): polls the directive

@@ -319,6 +319,19 @@ class ReplicaAgent:
             registry=self.registry, record=record)
         self.manager = self.router.manager
         self.warm_s = round(time.perf_counter() - t0, 3)
+        # rollout plane (serve/rollout.py drives this over POST
+        # /rollout): side-by-side version roots + per-version build_fns.
+        # The boot model is version None ('base'); each pulled version
+        # keeps its own store directory next to the boot export root.
+        self._model = model
+        self._variables = variables
+        self._run_fn_factory = run_fn_factory
+        self._record = record
+        self._boot_build_fn = self.manager._build_fn
+        self._target_replicas = cfg.fleet.replicas
+        self._versions: Dict[str, Dict] = {}
+        self._rollout_lock = threading.Lock()
+        self._shadow_seq = 0
         # recompile watch: lowerings AFTER this point are post-warm —
         # the join-cost acceptance reads the gauge this publishes
         self._lowerings = LoweringCounter().__enter__()
@@ -334,6 +347,7 @@ class ReplicaAgent:
             "export_root": self.cfg.fleet.export_dir or None,
             "programs": sum(r.describe().get("programs") or 0
                             for r in list(self.manager.replicas)),
+            "pulled_versions": sorted(self._versions),
         })
         return h
 
@@ -379,6 +393,186 @@ class ReplicaAgent:
         return {"replicas": len(self.manager.replicas),
                 "ready": len(self.manager.ready_replicas()),
                 "added": added, "drained": drained}
+
+    # -- rollout plane (serve/rollout.py — docs/SERVING.md "Rollout
+    # tier").  Every verb is a PUMP: cheap, idempotent, and safe for the
+    # controller to re-issue until the host reports done — a controller
+    # (or host) killed mid-verb loses no invariant, it just re-pumps.
+
+    def rollout_pull(self, url: Optional[str], version: str) -> Dict:
+        """Pull a version's export store ONCE into a version-keyed
+        sibling of the boot export root, run the LINEAGE admission
+        (``ExportStore.check_lineage`` — the boot store's manifest sha
+        is the only known parent), and register a per-version build_fn.
+        A repeat pull of a known version is a recorded no-op
+        (``already``) — the one-transfer-per-host invariant.  ``url``
+        empty registers a label-only version (stub/sim agents: same
+        run_fn factory, distinct routing version)."""
+        from mx_rcnn_tpu.serve.rollout import version_label
+
+        if not version or not isinstance(version, str):
+            raise ValueError("rollout pull needs a version id")
+        with self._rollout_lock:
+            known = self._versions.get(version)
+            if known is not None:
+                return {**known.get("pull", {}), "version": version,
+                        "already": True}
+            if not url:
+                # label-only: replicas build exactly like boot ones but
+                # carry the version tag (the stub tier has no stores)
+                self._versions[version] = {
+                    "root": None, "pull": {},
+                    "build_fn": self._boot_build_fn}
+                return {"version": version, "already": False,
+                        "label_only": True}
+            boot_root = self.cfg.fleet.export_dir
+            if not boot_root:
+                raise ValueError("rollout pull needs fleet.export_dir "
+                                 "as the local placement root")
+            from mx_rcnn_tpu.serve.export import (ExportStore,
+                                                  manifest_sha)
+            from mx_rcnn_tpu.serve.fleet import make_engine_build_fn
+
+            dest = f"{boot_root.rstrip('/')}@{version_label(version)}"
+            pull = pull_store(url, dest,
+                              timeout_s=self.cfg.crosshost.pull_timeout_s)
+            store = ExportStore(dest)
+            known_parents = None
+            boot_manifest = os.path.join(boot_root, MANIFEST_NAME)
+            if os.path.exists(boot_manifest):
+                known_parents = {manifest_sha(boot_root)}
+            lineage = store.check_lineage(known_parents=known_parents)
+            variables = (store.load_variables()
+                         if store.manifest().get("variables")
+                         else self._variables)
+            if self._run_fn_factory is not None:
+                build_fn = self._boot_build_fn
+            else:
+                build_fn = make_engine_build_fn(
+                    self.cfg, self._model, variables, export_root=dest)
+            self._versions[version] = {"root": dest, "pull": pull,
+                                       "lineage": lineage,
+                                       "build_fn": build_fn}
+            logger.info("agent rollout pull %s: %s", version, pull)
+            return {**pull, "version": version, "already": False,
+                    "lineage": lineage}
+
+    def _pump_toward(self, version: Optional[str], build_fn) -> Dict:
+        """One step of the rolling replace toward ``version``: keep the
+        replica count at the boot target, never drop below one ready
+        replica, and retire the outgoing version one GRACEFUL drain at a
+        time (the shipped drain path — queued work finishes serving).
+        Max overshoot is one replica (the incoming one warms while its
+        victim still serves)."""
+        want = self._target_replicas
+        replicas = list(self.manager.replicas)
+        target = [r for r in replicas if r.version == version]
+        old = [r for r in replicas if r.version != version]
+        target_ready = [r for r in target if r.ready()]
+        starting = [r for r in target if not r.ready()]
+        if not old and len(target) >= want and not starting:
+            return {"done": True, "remaining": 0}
+        if starting:
+            return {"pending": True, "remaining": len(old)}
+        if old and len(replicas) > want and target_ready:
+            victim = max([r for r in old if r.ready()] or old,
+                         key=lambda r: r.id)
+            rid = self.manager.drain_replica(rid=victim.id)
+            return {"swapped": rid, "remaining": max(len(old) - 1, 0)}
+        if len(target) < want:
+            r = self.manager.add_replica(build_fn=build_fn,
+                                         version=version)
+            return {"added": r.id, "pending": True,
+                    "remaining": len(old)}
+        return {"pending": True, "remaining": len(old)}
+
+    def rollout_swap(self, version: str) -> Dict:
+        """One rolling-replace step toward a PULLED version (400 via
+        ValueError otherwise).  When the host completes, scheduler
+        resizes keep building the new version."""
+        with self._rollout_lock:
+            entry = self._versions.get(version)
+            if entry is None:
+                raise ValueError(
+                    f"version {version!r} not pulled on this host")
+            res = self._pump_toward(version, entry["build_fn"])
+            if res.get("done"):
+                # repoint the default build path: post-rollout resize
+                # adds must build v2, not resurrect v1
+                self.manager._build_fn = entry["build_fn"]
+                self.manager.default_version = version
+            return res
+
+    def rollout_rollback(self) -> Dict:
+        """One rolling step back to the BOOT version — the first-class
+        rollback verb's per-host half.  Idempotent: a host already all
+        boot-version reports done without actuating anything."""
+        with self._rollout_lock:
+            res = self._pump_toward(None, self._boot_build_fn)
+            if res.get("done"):
+                self.manager._build_fn = self._boot_build_fn
+                self.manager.default_version = None
+            return res
+
+    def rollout_canary(self, version: Optional[str],
+                       fraction: float) -> Dict:
+        """Set (or clear) the local router's canary version lane."""
+        self.router.set_canary(version or None, float(fraction or 0.0))
+        c = self.router.canary()
+        return {"canary": list(c) if c is not None else None}
+
+    def rollout_status(self) -> Dict:
+        return {"versions": self.manager.versions(),
+                "pulled": sorted(self._versions),
+                "canary": self.rollout_canary_state(),
+                "replicas": len(self.manager.replicas)}
+
+    def rollout_canary_state(self) -> Optional[List]:
+        c = self.router.canary()
+        return list(c) if c is not None else None
+
+    def rollout_shadow(self) -> Dict:
+        """One paired shadow sample: the SAME deterministic canvas
+        through one base-arm replica and one canary-arm replica,
+        bypassing the router (the canary lane must not skew the pair),
+        scored by ``detection_score``.  Returns ``pair: null`` when the
+        host does not hold both arms ready — the controller's sampler
+        just tries another host."""
+        from mx_rcnn_tpu.serve.rollout import detection_score
+
+        c = self.router.canary()
+        if c is None:
+            return {"pair": None, "reason": "no canary lane"}
+        version = c[0]
+        base = [r for r in self.manager.ready_replicas()
+                if r.version != version]
+        canary = [r for r in self.manager.ready_replicas()
+                  if r.version == version]
+        if not base or not canary:
+            return {"pair": None, "reason": "arms not resident"}
+        with self._rollout_lock:
+            seq = self._shadow_seq
+            self._shadow_seq += 1
+        bh, bw = min((tuple(b) for b in self.cfg.bucket.shapes),
+                     key=lambda b: b[0] * b[1])
+        rng = np.random.RandomState(seq % (1 << 31))
+        data = (rng.rand(bh, bw, 3) * 255.0).astype(np.float32)
+        im_info = np.array([bh, bw, 1.0], np.float32)
+        scores = []
+        for r in (base[0], canary[0]):
+            with r._lock:
+                eng = r.engine
+            if eng is None:
+                return {"pair": None, "reason": "replica raced away"}
+            req = eng.submit_prepared(
+                data.copy(), im_info.copy(), (bh, bw),
+                timeout_ms=self.cfg.serve.default_timeout_ms)
+            try:
+                dets = req.wait(timeout=30.0)
+            except Exception as e:
+                return {"pair": None, "reason": f"{type(e).__name__}"}
+            scores.append(detection_score(dets))
+        return {"pair": [scores[0], scores[1]], "seq": seq}
 
     def close(self, timeout: float = 10.0) -> None:
         self.router.close(timeout)
@@ -526,6 +720,28 @@ class _AgentHandler(BaseHTTPRequestHandler):
                     raise ValueError("body must be a JSON object")
                 self._reply_json(200, agent.resize(
                     target=body.get("target"), delta=body.get("delta")))
+            elif self.path == "/rollout":
+                body = json.loads(self._read_body().decode() or "{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+                op = body.get("op")
+                if op == "pull":
+                    self._reply_json(200, agent.rollout_pull(
+                        body.get("url"), body.get("version")))
+                elif op == "swap":
+                    self._reply_json(200, agent.rollout_swap(
+                        body.get("version")))
+                elif op == "rollback":
+                    self._reply_json(200, agent.rollout_rollback())
+                elif op == "canary":
+                    self._reply_json(200, agent.rollout_canary(
+                        body.get("version"), body.get("fraction")))
+                elif op == "shadow":
+                    self._reply_json(200, agent.rollout_shadow())
+                elif op == "status":
+                    self._reply_json(200, agent.rollout_status())
+                else:
+                    raise ValueError(f"unknown rollout op {op!r}")
             else:
                 self._reply_json(404, {"error": f"no route {self.path}"})
         except BodyError as e:

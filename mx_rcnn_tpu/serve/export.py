@@ -46,6 +46,69 @@ logger = logging.getLogger("mx_rcnn_tpu")
 
 MANIFEST_NAME = "manifest.json"
 CACHE_SUBDIR = "xla_cache"
+VARIABLES_NAME = "variables.npz"
+
+
+def manifest_sha(root: str) -> str:
+    """The store's identity for lineage purposes: sha256 of the
+    committed manifest bytes.  A child store records its parent's
+    manifest sha as ``parent_sha`` — any change to the parent (programs,
+    fingerprints, weights payload) changes the identity, so a forged or
+    drifted parent can never satisfy the admission check."""
+    path = os.path.join(root, MANIFEST_NAME)
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _flatten_variables(variables, prefix: str = "") -> Dict[str, np.ndarray]:
+    """Nested variables dict → flat ``{'a/b/c': array}`` (npz-able)."""
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(variables, dict):
+        for k in sorted(variables):
+            out.update(_flatten_variables(variables[k], f"{prefix}{k}/"))
+    else:
+        out[prefix.rstrip("/")] = np.asarray(variables)
+    return out
+
+
+def _empty_subtrees(variables, prefix: str = "") -> List[str]:
+    """Paths of dict subtrees with NO leaves (e.g. a BN-free model's
+    ``batch_stats: {}``) — invisible to :func:`_flatten_variables` but
+    part of the pytree structure exported programs are called with."""
+    out: List[str] = []
+    if isinstance(variables, dict):
+        if not variables:
+            out.append(prefix.rstrip("/"))
+        for k in sorted(variables):
+            out.extend(_empty_subtrees(variables[k], f"{prefix}{k}/"))
+    return out
+
+
+def _unflatten_variables(flat: Dict[str, np.ndarray]) -> Dict:
+    out: Dict = {}
+    for key, arr in flat.items():
+        node = out
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return out
+
+
+def variables_fingerprint(variables) -> str:
+    """Content fingerprint of a weights pytree (the ``train_fingerprint``
+    lineage field): sha256 over sorted leaf paths, dtypes, shapes and
+    raw bytes.  Two checkpoints that would serve different boxes can
+    never share a fingerprint; re-exporting identical weights always
+    reproduces it."""
+    h = hashlib.sha256()
+    for key, arr in sorted(_flatten_variables(variables).items()):
+        a = np.ascontiguousarray(arr)
+        h.update(key.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
 
 
 class ExportMismatch(RuntimeError):
@@ -171,6 +234,137 @@ class ExportStore:
             "args": _describe(args),
             "static": {k: v for k, v in (static_kwargs or {}).items()},
         }
+
+    def add_variables(self, variables) -> None:
+        """Bundle the weights payload into the store (npz of flattened
+        leaves, sha-pinned like every program entry) and record its
+        content fingerprint as the manifest's ``train_fingerprint``.
+
+        Exported programs keep weights as call arguments ("parameters
+        stay checkpoint arguments"), so a VERSIONED store must carry the
+        weights a rollout is actually shipping — otherwise pulling v2
+        would swap programs but keep serving v1's model.  Lives outside
+        ``entries`` (those are jax programs; ``load``/``names`` must not
+        trip over a payload blob)."""
+        import io
+
+        from mx_rcnn_tpu.utils.checkpoint import _atomic_write
+
+        buf = io.BytesIO()
+        np.savez(buf, **_flatten_variables(variables))
+        blob = buf.getvalue()
+        _atomic_write(os.path.join(self.root, VARIABLES_NAME), blob)
+        self._manifest["variables"] = {
+            "file": VARIABLES_NAME,
+            "bytes": len(blob),
+            "sha256": hashlib.sha256(blob).hexdigest(),
+            # leaf-less subtrees (a BN-free model's empty batch_stats)
+            # vanish in the npz flatten; the exported programs' calling
+            # convention still requires them, so record their paths and
+            # rebuild them on load
+            "empty_subtrees": _empty_subtrees(variables),
+        }
+        self._manifest["train_fingerprint"] = \
+            variables_fingerprint(variables)
+
+    def load_variables(self) -> Dict:
+        """Load the bundled weights payload (sha-verified, typed refusal
+        on corruption — same contract as :meth:`load`)."""
+        import io
+
+        m = self.manifest()
+        entry = m.get("variables")
+        if entry is None:
+            raise ExportMismatch(
+                f"export store {self.root} bundles no variables payload "
+                "— it cannot ship a model version by itself")
+        path = os.path.join(self.root, entry["file"])
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except FileNotFoundError:
+            raise ExportMismatch(
+                f"export store {self.root} is missing {entry['file']} "
+                "although the manifest names it — the store is corrupt; "
+                "re-export") from None
+        sha = hashlib.sha256(blob).hexdigest()
+        if sha != entry["sha256"]:
+            raise ExportMismatch(
+                f"variables payload {path} is corrupt: sha256 {sha} != "
+                f"manifest {entry['sha256']}")
+        with np.load(io.BytesIO(blob)) as z:
+            variables = _unflatten_variables({k: z[k] for k in z.files})
+        for path in entry.get("empty_subtrees", []):
+            node = variables
+            parts = [p for p in path.split("/") if p]
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            if parts:
+                node.setdefault(parts[-1], {})
+        return variables
+
+    # ------------------------------------------------------------------
+    # lineage (docs/SERVING.md "Rollout tier")
+    # ------------------------------------------------------------------
+
+    @property
+    def version(self) -> Optional[str]:
+        """The store's version id, or None for a legacy version-less
+        store (every store exported before the rollout plane)."""
+        return self.manifest().get("version")
+
+    @property
+    def parent_sha(self) -> Optional[str]:
+        return self.manifest().get("parent_sha")
+
+    def check_lineage(self, known_parents=None,
+                      expect_train_fingerprint: str = None) -> Dict:
+        """Rollout admission over the lineage fields — run IN ADDITION
+        to :meth:`check` (which pins config/jax/bucket/quant semantics):
+
+        * ``known_parents`` (iterable of manifest shas): the versions
+          this fleet currently serves.  A versioned store whose
+          ``parent_sha`` is not among them is REFUSED (unknown parent —
+          a v2 built against some other fleet's v1 must not land here);
+          a versioned store recording no parent at all is likewise
+          refused when a parent set is required.
+        * ``expect_train_fingerprint``: refusal when the manifest's
+          recorded ``train_fingerprint`` differs — the
+          fingerprint-mismatch rule (a store whose recorded weights
+          identity disagrees with what the operator pinned).
+
+        Back-compat: a manifest WITHOUT a ``version`` field is a legacy
+        store — it predates lineage, carries no claims, and admits
+        unchanged (same idiom as quant admission's "old manifests
+        without the key count as fp stores"); pinned by
+        tests/test_rollout.py."""
+        m = self.manifest()
+        if "version" not in m:
+            return {"version": None, "parent_sha": None, "legacy": True}
+        version = m["version"]
+        parent = m.get("parent_sha")
+        if known_parents is not None:
+            known = set(known_parents)
+            if parent is None:
+                raise ExportMismatch(
+                    f"export store {self.root} (version {version!r}) "
+                    "records no parent_sha but this fleet requires "
+                    "lineage — refusing an unrooted version")
+            if parent not in known:
+                raise ExportMismatch(
+                    f"export store {self.root} (version {version!r}) "
+                    f"has unknown parent {parent[:12]}… — not among the "
+                    f"{len(known)} version(s) this fleet serves")
+        recorded_fp = m.get("train_fingerprint")
+        if (expect_train_fingerprint is not None
+                and recorded_fp != expect_train_fingerprint):
+            raise ExportMismatch(
+                f"export store {self.root} (version {version!r}) "
+                f"train_fingerprint {str(recorded_fp)[:12]}… != expected "
+                f"{expect_train_fingerprint[:12]}… — the shipped weights "
+                "are not the weights this rollout was approved for")
+        return {"version": version, "parent_sha": parent,
+                "train_fingerprint": recorded_fp, "legacy": False}
 
     def finish(self) -> str:
         """Commit the manifest (written LAST: its presence means every
@@ -330,8 +524,9 @@ def _dummy_batch(bucket: Tuple[int, int], n: int, seed: int = 0
 
 
 def export_serve_programs(predictor, cfg, root: str, *,
-                          eval_batch: int = None, verify: bool = True
-                          ) -> Dict:
+                          eval_batch: int = None, verify: bool = True,
+                          version: str = None, parent: str = None,
+                          bundle_variables: bool = False) -> Dict:
     """Export every per-bucket serving program + the shared postprocess
     (+ the eval ``Predictor`` step at ``eval_batch`` rows) into an
     :class:`ExportStore` at ``root``, and — unless ``verify=False`` —
@@ -340,6 +535,14 @@ def export_serve_programs(predictor, cfg, root: str, *,
     persistent-cache population step: run it with
     ``enable_compile_cache(store.cache_dir())`` armed and a joining
     replica's compiles become cache reads.
+
+    Lineage (docs/SERVING.md "Rollout tier"): ``version`` stamps the
+    store with an explicit version id, ``parent`` (a parent store ROOT
+    or a manifest sha) records what this version supersedes, and
+    ``bundle_variables`` ships the weights payload inside the store so
+    a rollout pull delivers the whole model.  All three default off —
+    version-less exports stay byte-compatible with every pre-rollout
+    consumer.
 
     Returns a report dict (programs, bytes, verified flags) that
     ``tools/fleet.py export`` prints and the manifest summarizes.
@@ -362,14 +565,21 @@ def export_serve_programs(predictor, cfg, root: str, *,
 
         quant_meta = quant_manifest_meta(cfg.quant,
                                          predictor.quant_fingerprint)
-    store = ExportStore.create(
-        root, cfg, extra_meta={
-            "serve_batch_size": n,
-            "eval_batch_size": eval_batch,
-            "nms_thresh": cfg.test.nms,
-            "serve_score_thresh": cfg.serve.score_thresh,
-            "quant": quant_meta,
-        })
+    extra_meta = {
+        "serve_batch_size": n,
+        "eval_batch_size": eval_batch,
+        "nms_thresh": cfg.test.nms,
+        "serve_score_thresh": cfg.serve.score_thresh,
+        "quant": quant_meta,
+    }
+    if version is not None:
+        extra_meta["version"] = version
+        if parent is not None and os.path.isdir(str(parent)):
+            parent = manifest_sha(str(parent))
+        extra_meta["parent_sha"] = parent
+    store = ExportStore.create(root, cfg, extra_meta=extra_meta)
+    if bundle_variables:
+        store.add_variables(variables)
     report: Dict = {"root": root, "programs": [], "verified": verify,
                     "bit_equal": None}
 

@@ -56,6 +56,10 @@ from mx_rcnn_tpu.serve.queue import (EXPIRED, FAILED, PENDING, SERVED, SHED,
 
 logger = logging.getLogger("mx_rcnn_tpu")
 
+# drain_replica "any version" sentinel (None is a real version — the
+# boot model — so a default arg can't be None)
+_ANY_VERSION = object()
+
 # replica lifecycle states (healthz-visible)
 R_STARTING = "starting"
 R_READY = "ready"
@@ -98,7 +102,7 @@ class FleetRequest(ServeRequest):
     a drained burst holds no pixel memory.
     """
 
-    __slots__ = ("attempts", "tried", "replica_id", "prepared")
+    __slots__ = ("attempts", "tried", "replica_id", "prepared", "version")
 
     def __init__(self, image: np.ndarray, deadline: Optional[float],
                  now: float, im_info: np.ndarray = None,
@@ -107,6 +111,9 @@ class FleetRequest(ServeRequest):
         self.attempts = 0          # dispatches so far (1 = no reroute)
         self.tried: set = set()    # replica ids already dispatched to
         self.replica_id: Optional[int] = None  # last dispatch target
+        # model version of the last dispatch target (rollout plane):
+        # stamps the per-version exactly-once accounting at terminal
+        self.version: Optional[str] = None
         # bulk plane (serve/bulk.py): image is the ALREADY-preprocessed
         # fp32 bucket canvas and im_info its record — dispatch goes
         # through ``ServingEngine.submit_prepared`` (a reroute re-offers
@@ -121,7 +128,14 @@ class Replica:
     engine (export-warm or trace-warm — the manager records which and
     how long).  All state transitions happen under ``_lock``; the
     routing set reads ``ready()`` lock-free-ish (one lock hop).
+
+    ``version`` (class default None = the boot model) tags which model
+    version this replica serves — each replica owns its build_fn, so a
+    rollout builds v2 replicas from the v2 store while v1 replicas keep
+    their original closure, side by side in one routing set.
     """
+
+    version: Optional[str] = None
 
     def __init__(self, rid: int,
                  build_fn: Callable[[int], Tuple[ServingEngine, Dict]],
@@ -199,6 +213,7 @@ class Replica:
             eng = self.engine
             d = {"id": self.id, "state": self.state,
                  "generation": self.generation,
+                 "version": self.version,
                  "last_join_s": (self.joins[-1]["join_s"]
                                  if self.joins else None)}
             if eng is not None and self.state == R_READY:
@@ -233,6 +248,10 @@ class ReplicaManager:
         # (serve/remote.py) through this same lifecycle
         self._replica_cls = replica_cls or Replica
         self._build_fn = build_fn
+        # the version plain resize-adds are tagged with (the rollout
+        # plane repoints this together with _build_fn when a host
+        # completes a swap, so scheduler adds keep building v2)
+        self.default_version: Optional[str] = None
         self.replicas = [self._replica_cls(i, build_fn)
                          for i in range(cfg.fleet.replicas)]
         # resize surface (serve/scheduler.py → agent /replicas): list
@@ -290,25 +309,46 @@ class ReplicaManager:
     def ready_replicas(self) -> List[Replica]:
         return [r for r in list(self.replicas) if r.ready()]
 
+    def versions(self) -> Dict[str, int]:
+        """Ready capacity per model-version label (rollout status
+        surface; 'base' is the boot version)."""
+        from mx_rcnn_tpu.serve.rollout import version_label
+
+        out: Dict[str, int] = {}
+        for r in self.ready_replicas():
+            lbl = version_label(r.version)
+            out[lbl] = out.get(lbl, 0) + 1
+        return out
+
     # ------------------------------------------------------------------
     # resize (the scheduler's add/drain surface — serve/scheduler.py
     # drives it through the agent's POST /replicas)
     # ------------------------------------------------------------------
 
-    def add_replica(self) -> Replica:
+    def add_replica(self, build_fn: Callable = None,
+                    version: str = None) -> Replica:
         """Grow the set by one replica (fresh id — ids are never
         reused, so per-replica gauges and flight records stay
         unambiguous).  The launch runs on its own thread: the caller
         (an HTTP control handler) must not block for a multi-second
         warmup; a boot failure lands in the standard RestartPolicy
-        relaunch schedule."""
+        relaunch schedule.
+
+        ``build_fn``/``version`` (rollout plane): build this replica
+        from a DIFFERENT store than the boot set — a v2 replica joins
+        the same routing set tagged with its version; default keeps the
+        manager's boot build_fn and the boot (None) version."""
         with self._resize_lock:
             rid = self._next_rid
             self._next_rid += 1
-            r = self._replica_cls(rid, self._build_fn)
+            r = self._replica_cls(rid, build_fn or self._build_fn)
+            r.version = (version if (version is not None
+                                     or build_fn is not None)
+                         else self.default_version)
             self.replicas.append(r)
         if self.record is not None:
-            self.record.event("fleet_scale", action="add", replica=rid)
+            self.record.event("fleet_scale", action="add", replica=rid,
+                              version=version)
 
         def boot():
             if not r.launch():
@@ -319,19 +359,26 @@ class ReplicaManager:
                          daemon=True).start()
         return r
 
-    def drain_replica(self, rid: int = None) -> Optional[int]:
+    def drain_replica(self, rid: int = None,
+                      version=_ANY_VERSION) -> Optional[int]:
         """Shrink the set by one replica: remove it from routing, then
         drain-close its engine (queued work finishes serving — a drain
         is graceful by definition; abrupt death is ``eject``'s job).
         Default victim: the highest-id ready replica.  Refuses to drain
         the last replica (a fleet of zero serves nothing and can never
         recover without an external add).  Returns the drained id, or
-        None if nothing was eligible."""
+        None if nothing was eligible.
+
+        ``version`` narrows the default-victim pool to replicas of one
+        model version (None = the boot version) — the rollout swaps
+        "drain one v1" without naming ids."""
         with self._resize_lock:
             if len(self.replicas) <= 1:
                 return None
             if rid is None:
                 cands = [r for r in self.replicas if r.ready()]
+                if version is not _ANY_VERSION:
+                    cands = [r for r in cands if r.version == version]
                 if not cands:
                     return None
                 r = max(cands, key=lambda x: x.id)
@@ -463,6 +510,79 @@ class FleetRouter:
         self.cfg = cfg
         self.metrics = metrics or FleetMetrics()
         self._rr = itertools.count()  # JSQ tie-break rotation
+        # canary version lane (rollout plane): (version, fraction) or
+        # None; the fraction accumulator makes lane choice DETERMINISTIC
+        # (request k goes canary iff floor(k·f) > floor((k−1)·f)), so
+        # the sim's decision log is byte-reproducible and a 25% canary
+        # is exactly 1-in-4, not a coin flip
+        self._canary_lock = threading.Lock()
+        self._canary: Optional[Tuple[str, float]] = None
+        self._canary_acc = 0.0
+
+    # ------------------------------------------------------------------
+    # canary version lane (serve/rollout.py drives this)
+    # ------------------------------------------------------------------
+
+    def set_canary(self, version: Optional[str], fraction: float) -> None:
+        """Route ``fraction`` of admitted traffic to replicas of
+        ``version`` (the rest to everything else).  ``version=None``
+        clears the lane (version-blind JSQ); fraction 0.0 with a version
+        set starves that version of NEW work — the rollback posture
+        while v2 replicas drain."""
+        with self._canary_lock:
+            if version is None:
+                self._canary = None
+            else:
+                self._canary = (version,
+                                max(0.0, min(1.0, float(fraction))))
+            self._canary_acc = 0.0
+
+    def canary(self) -> Optional[Tuple[str, float]]:
+        with self._canary_lock:
+            return self._canary
+
+    def _canary_lane(self, cands: List[Replica]) -> List[Replica]:
+        """Partition the JSQ candidate set by the canary lane choice.
+        Availability outranks canary purity: an empty chosen lane falls
+        back to the full candidate set (counted — a fallback-heavy
+        canary means the fraction outruns v2 capacity), so the lane can
+        never fail a request that ANY replica could serve."""
+        with self._canary_lock:
+            if self._canary is None:
+                return cands
+            version, fraction = self._canary
+            self._canary_acc += fraction
+            take = self._canary_acc >= 1.0
+            if take:
+                self._canary_acc -= 1.0
+        lane = [r for r in cands if (r.version == version) == take]
+        if lane:
+            return lane
+        self.metrics.count("canary_fallback")
+        return cands
+
+    def _count_version(self, freq: FleetRequest, state: str,
+                       ms: float = None) -> None:
+        """Per-version terminal accounting (``fleet.ver.<label>.*`` —
+        the series :func:`~mx_rcnn_tpu.serve.rollout.rollout_rules`
+        compares): counted for requests that reached a replica, under
+        the version of the LAST dispatch target, so per-version sums
+        reconcile exactly with the fleet terminals that dispatched."""
+        if freq.replica_id is None:
+            return
+        from mx_rcnn_tpu.serve.rollout import version_label
+
+        lbl = version_label(freq.version)
+        # publish into the manager's (scrape-visible) registry when one
+        # exists — an agent's canary series must reach the /metrics
+        # plane the rollout health rules judge; the in-process tier
+        # falls back to the router's private fleet registry
+        reg = (self.manager.registry
+               if self.manager.registry is not None
+               else self.metrics.registry)
+        reg.inc(f"fleet.ver.{lbl}.{state}")
+        if ms is not None:
+            reg.observe(f"fleet.ver.{lbl}.total_ms", ms)
 
     # ------------------------------------------------------------------
     # request path
@@ -539,6 +659,7 @@ class FleetRouter:
         if freq.expired(now):
             if freq._finish(EXPIRED):
                 self.metrics.count("expired")
+                self._count_version(freq, "expired")
                 freq.image = None
             return
         cands = [r for r in self.manager.ready_replicas()
@@ -549,8 +670,10 @@ class FleetRouter:
                 f"(tried {sorted(freq.tried) or 'none'})")
             if freq._finish(FAILED, error=err):
                 self.metrics.count("failed")
+                self._count_version(freq, "failed")
                 freq.image = None
             return
+        cands = self._canary_lane(cands)
         bucket = self._route_bucket(freq)
         batch = self.cfg.serve.batch_size
         rot = next(self._rr)
@@ -567,6 +690,8 @@ class FleetRouter:
         freq.tried.add(target.id)
         freq.attempts += 1
         freq.replica_id = target.id
+        freq.version = target.version
+        self._count_version(freq, "dispatched")
         with target._lock:
             eng = target.engine if target.state == R_READY else None
         if eng is None:  # lost the race with an eject — try the rest
@@ -596,9 +721,10 @@ class FleetRouter:
         if state == SERVED:
             freq.batch_rows = inner.batch_rows
             if freq._finish(SERVED, result=inner.result):
+                ms = (freq.done_t - freq.enqueue_t) * 1e3
                 self.metrics.count("served")
-                self.metrics.observe(
-                    "total_ms", (freq.done_t - freq.enqueue_t) * 1e3)
+                self.metrics.observe("total_ms", ms)
+                self._count_version(freq, "served", ms=ms)
                 freq.image = None
         elif state == SHED:
             if eng is not None and eng._closed:
@@ -611,10 +737,12 @@ class FleetRouter:
             # shed means the whole fleet is saturated — 429, immediately
             if freq._finish(SHED):
                 self.metrics.count("shed")
+                self._count_version(freq, "shed")
                 freq.image = None
         elif state == EXPIRED:
             if freq._finish(EXPIRED):
                 self.metrics.count("expired")
+                self._count_version(freq, "expired")
                 freq.image = None
         else:  # FAILED — replica died under it, or the batch errored
             self._retry_or_fail(freq, inner)
@@ -630,6 +758,7 @@ class FleetRouter:
         if freq.expired(time.monotonic()):
             if freq._finish(EXPIRED):
                 self.metrics.count("expired")
+                self._count_version(freq, "expired")
                 freq.image = None
             return
         if freq.attempts < 1 + max(self.cfg.fleet.reroute_retries, 0):
@@ -637,6 +766,7 @@ class FleetRouter:
             self._dispatch(freq)
         elif freq._finish(FAILED, error=inner.error):
             self.metrics.count("failed")
+            self._count_version(freq, "failed")
             freq.image = None
 
     # ------------------------------------------------------------------
@@ -655,6 +785,9 @@ class FleetRouter:
             "relaunches": self.manager.relaunches,
             "buckets": [list(b) for b in self.cfg.bucket.shapes],
             "batch_size": self.cfg.serve.batch_size,
+            "versions": self.manager.versions(),
+            "canary": (list(self.canary()) if self.canary() is not None
+                       else None),
         }
 
     def rerouted(self) -> int:

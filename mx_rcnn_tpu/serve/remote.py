@@ -554,6 +554,16 @@ class RemoteReplica(Replica):
             eng = self.engine
         return eng.agent_url if isinstance(eng, RemoteEngine) else None
 
+    def agent_versions(self) -> Optional[Dict]:
+        """The host's per-version ready capacity as of its last healthz
+        probe (rollout plane status surface — a mid-rollout host reports
+        both arms here; None before the first probe)."""
+        with self._lock:
+            eng = self.engine
+        if not isinstance(eng, RemoteEngine):
+            return None
+        return eng._last_healthz.get("versions")
+
 
 def make_remote_build_fn(cfg: Config, agent_urls: List[str]):
     """``build_fn(rid) -> (RemoteEngine, join_stats)`` — replica rid is

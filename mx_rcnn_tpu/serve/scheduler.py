@@ -294,6 +294,17 @@ class AgentAdmin:
         except (OSError, ValueError) as e:
             raise AgentAdminError(f"{url}{path}: {e}") from e
 
+    def call(self, source: str, path: str, body: Dict) -> Dict:
+        """Generic admin RPC to one agent (the rollout plane's
+        transport — ``serve/rollout.py AgentRolloutPort`` routes every
+        controller verb through this).  Same typed-failure contract as
+        :meth:`resize`, but the error PROPAGATES: the rollout
+        controller owns retry/defer policy, not the transport."""
+        url = self.by_source.get(source)
+        if url is None:
+            raise AgentAdminError(f"unknown agent source {source!r}")
+        return self._post(url, path, body)
+
     def resize(self, source: str, delta: int) -> Optional[Dict]:
         url = self.by_source.get(source)
         if url is None:
@@ -327,6 +338,13 @@ class FleetScheduler:
         self.cfg = cfg
         self.record = record
         self.actions: List[Dict] = []
+        # tick() runs on the daemon thread; rollback() arrives from
+        # whoever holds the controller — one lock covers the shared
+        # action history
+        self._actions_lock = threading.Lock()
+        # attached rollout controller (serve/rollout.py) — gives the
+        # scheduler its third verb, rollback, next to add/drain
+        self.rollout = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -342,13 +360,38 @@ class FleetScheduler:
             # "the agent hung" and "the agent refused" stay legible in
             # scheduler.actions / the flight recorder
             action["error"] = type(self.admin.last_error).__name__
-        self.actions.append(action)
+        with self._actions_lock:
+            self.actions.append(action)
         logger.info("scheduler: %s on %s (%s) -> %s", action["action"],
                     action["source"], action["reason"],
                     action["result"])
         if self.record is not None:
             self.record.event("fleet_schedule", **{
                 k: action[k] for k in ("action", "source", "reason")})
+        return action
+
+    def rollback(self, reason: str = "operator") -> Dict:
+        """The first-class rollback verb: ONE actuation returns every
+        host to the boot version (docs/SERVING.md "Rollout tier").
+        Requires an attached rollout controller (``self.rollout``);
+        idempotent the same way the controller is, and recorded in
+        ``self.actions`` next to add/drain so the tick history tells
+        the whole story."""
+        if self.rollout is None:
+            action = {"action": "rollback", "reason": reason,
+                      "result": None, "error": "NoRolloutController"}
+            with self._actions_lock:
+                self.actions.append(action)
+            return action
+        result = self.rollout.rollback(reason)
+        action = {"action": "rollback", "reason": reason,
+                  "result": result}
+        with self._actions_lock:
+            self.actions.append(action)
+        logger.warning("scheduler: rollback (%s) -> %s", reason, result)
+        if self.record is not None:
+            self.record.event("fleet_schedule", action="rollback",
+                              source="*", reason=reason)
         return action
 
     def start(self) -> "FleetScheduler":

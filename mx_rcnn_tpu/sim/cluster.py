@@ -39,6 +39,7 @@ from typing import Callable, Deque, Dict, List, Optional, Tuple
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.obs.metrics import Registry
 from mx_rcnn_tpu.serve.fleet import jsq_key
+from mx_rcnn_tpu.serve.rollout import version_label
 from mx_rcnn_tpu.sim.kernel import SimKernel
 
 # replica lifecycle (the sim's reduction of serve/fleet.py R_* states)
@@ -50,7 +51,7 @@ SERVED, SHED, EXPIRED, FAILED = "SERVED", "SHED", "EXPIRED", "FAILED"
 
 class SimRequest:
     __slots__ = ("rid", "bucket", "t_arrive", "deadline", "attempts",
-                 "tried", "state", "t_done")
+                 "tried", "state", "t_done", "version", "routed")
 
     def __init__(self, rid: int, bucket: Tuple[int, int],
                  t_arrive: float, deadline: Optional[float]):
@@ -62,6 +63,12 @@ class SimRequest:
         self.tried: set = set()
         self.state: Optional[str] = None
         self.t_done: Optional[float] = None
+        # per-version exactly-once accounting: the LAST dispatch
+        # target's version owns this request's terminal (mirrors the
+        # live router, which counts only requests that reached a
+        # replica)
+        self.version: Optional[str] = None
+        self.routed = False
 
     def past_deadline(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
@@ -69,15 +76,19 @@ class SimRequest:
 
 class SimReplica:
     __slots__ = ("rid", "host", "state", "lanes", "in_flight",
-                 "generation")
+                 "generation", "version")
 
-    def __init__(self, rid: int, host: "SimHost", state: str = READY):
+    def __init__(self, rid: int, host: "SimHost", state: str = READY,
+                 version: Optional[str] = None):
         self.rid = rid               # fleet-unique: the JSQ tiebreak id
         self.host = host
         self.state = state
         self.lanes: Dict[Tuple[int, int], Deque[SimRequest]] = {}
         self.in_flight: List[SimRequest] = []
         self.generation = 0
+        # export-store version this replica serves (None = the boot
+        # store — the sim analog of Replica.version in serve/fleet.py)
+        self.version = version
 
     def lane_depth(self, bucket: Tuple[int, int]) -> int:
         lane = self.lanes.get(bucket)
@@ -103,6 +114,15 @@ class SimHost:
         self.registry = Registry()
         self.replicas: List[SimReplica] = [
             SimReplica(next_rid(), self) for _ in range(boot_replicas)]
+        # rollout plane: versions this host has pulled, and in-progress
+        # pulls (version -> virtual completion time).  Both reset on
+        # relaunch — a fresh process re-pulls (the live agent's pull is
+        # idempotent/resumable for the same reason)
+        self.pulled: set = set()
+        self.pulling: Dict[str, float] = {}
+        # the version scheduler resizes build (the live agent repoints
+        # this when a swap completes, so post-rollout adds stay v2)
+        self.default_version: Optional[str] = None
 
     def ready_replicas(self) -> List[SimReplica]:
         return [r for r in self.replicas if r.state == READY]
@@ -152,6 +172,12 @@ class SimCluster:
         self.stats = {"submitted": 0, "served": 0, "shed": 0,
                       "expired": 0, "failed": 0, "rerouted": 0}
         self.wait_ms_max = 0.0
+        # rollout plane: the deterministic canary lane (mirrors
+        # FleetRouter.set_canary's fraction accumulator) and exact
+        # per-version terminal counts keyed by version label
+        self._canary: Optional[Tuple[str, float]] = None
+        self._canary_acc = 0.0
+        self.ver_stats: Dict[str, Dict[str, int]] = {}
 
     # -- gauge surface (called by the harness before every scrape) --------
 
@@ -211,6 +237,7 @@ class SimCluster:
         if not cands:
             self._settle(req, FAILED)
             return
+        cands = self._canary_lane(cands)
         batch = self.cfg.serve.batch_size
         self._rot += 1
         rot, n = self._rot, len(cands)
@@ -220,6 +247,11 @@ class SimCluster:
                                            batch))
         req.tried.add(target.rid)
         req.attempts += 1
+        req.version = target.version
+        req.routed = True
+        self._ver(version_label(req.version))["dispatched"] += 1
+        self.head.inc(f"fleet.ver.{version_label(req.version)}"
+                      ".dispatched")
         if target.lane_depth(req.bucket) >= self.cfg.serve.shed_watermark:
             # the least-loaded lane is at its watermark: the fleet is
             # saturated — terminal 429, no retry (fleet.py contract)
@@ -292,12 +324,56 @@ class SimCluster:
             return
         self._settle(req, FAILED)
 
+    def _ver(self, label: str) -> Dict[str, int]:
+        return self.ver_stats.setdefault(
+            label, {"dispatched": 0, "served": 0, "shed": 0,
+                    "expired": 0, "failed": 0})
+
+    def _canary_lane(self, cands: List[SimReplica]) -> List[SimReplica]:
+        """Mirror of ``FleetRouter._canary_lane``: a deterministic
+        fraction accumulator steers every Nth dispatch to the canary
+        version; an empty lane falls back to the full candidate set
+        (availability outranks canary purity), counted."""
+        if self._canary is None:
+            return cands
+        version, fraction = self._canary
+        self._canary_acc += fraction
+        take = self._canary_acc >= 1.0
+        if take:
+            self._canary_acc -= 1.0
+        lane = [r for r in cands if (r.version == version) == take]
+        if not lane:
+            self.head.inc("fleet.canary_fallback")
+            return cands
+        return lane
+
+    def set_canary(self, version: Optional[str],
+                   fraction: float) -> None:
+        if version is None:
+            self._canary = None
+        else:
+            self._canary = (version,
+                            max(0.0, min(float(fraction), 1.0)))
+        self._canary_acc = 0.0
+        self.log("set_canary",
+                 version=None if version is None
+                 else version_label(version),
+                 fraction=round(float(fraction), 4))
+
     def _settle(self, req: SimRequest, state: str) -> None:
         req.state = state
         req.t_done = self.k.clock.now
         key = state.lower()
         self.stats[key] += 1
         self.head.inc(f"fleet.{key}")
+        if req.routed:
+            lbl = version_label(req.version)
+            self._ver(lbl)[key] += 1
+            self.head.inc(f"fleet.ver.{lbl}.{key}")
+            if state == SERVED:
+                self.head.observe(
+                    f"fleet.ver.{lbl}.total_ms",
+                    (req.t_done - req.t_arrive) * 1000.0)
 
     # -- failure / actuation events ---------------------------------------
 
@@ -330,6 +406,12 @@ class SimCluster:
         per_host = max(int(self.cfg.crosshost.agent_replicas), 1)
         h.replicas = [SimReplica(self._next_rid(), h, state=WARMING)
                       for _ in range(per_host)]
+        # a fresh process boots from the boot store: pulled versions
+        # are gone, replicas come up version-less (the FINALIZE
+        # convergence path re-pulls and re-swaps them)
+        h.pulled = set()
+        h.pulling = {}
+        h.default_version = None
         h.up = True
         self.log("host_up", host=h.name, generation=h.generation)
         for r in h.replicas:
@@ -351,7 +433,8 @@ class SimCluster:
         if not h.up:
             return None
         if delta >= 1:
-            r = SimReplica(self._next_rid(), h, state=WARMING)
+            r = SimReplica(self._next_rid(), h, state=WARMING,
+                           version=h.default_version)
             h.replicas.append(r)
             self.k.after(self.cfg.sim.warmup_s,
                          lambda rr=r: self._replica_ready(rr))
@@ -400,6 +483,107 @@ class SimCluster:
         self.log("host_dark", host=h.name)
         self.k.after(self.cfg.sim.relaunch_s,
                      lambda: self.host_up(index))
+
+    # -- rollout plane (SimRolloutPort verbs) ------------------------------
+
+    def pull_version(self, index: int, version: str) -> Optional[Dict]:
+        """The agent's ``/rollout op=pull``: idempotent, takes
+        ``rollout.pull_s`` virtual seconds the first time (returning
+        None while in flight — the controller's retry/defer machinery
+        owns the wait, exactly as live)."""
+        h = self.hosts[index]
+        if not h.up:
+            return None
+        if version in h.pulled:
+            return {"ok": True, "version": version, "already": True}
+        now = self.k.clock.now
+        t_done = h.pulling.get(version)
+        if t_done is None:
+            h.pulling[version] = now + self.cfg.rollout.pull_s
+            self.log("pull_start", host=h.name,
+                     version=version_label(version))
+            return None
+        if now < t_done:
+            return None
+        del h.pulling[version]
+        h.pulled.add(version)
+        self.log("pulled", host=h.name, version=version_label(version))
+        return {"ok": True, "version": version, "already": False}
+
+    def host_versions(self, index: int) -> Optional[Dict[str, int]]:
+        """``{version_label: ready_count}`` — the agent's rollout
+        status surface; None when the host is down."""
+        h = self.hosts[index]
+        if not h.up:
+            return None
+        out: Dict[str, int] = {}
+        for r in h.replicas:
+            if r.state == READY:
+                lbl = version_label(r.version)
+                out[lbl] = out.get(lbl, 0) + 1
+        return out
+
+    def swap_replica(self, index: int, version: str) -> Optional[Dict]:
+        """One pump of the agent's rolling replace toward ``version``.
+        An unpulled version answers None (a relaunched host lost its
+        pull — the controller defers and FINALIZE re-converges)."""
+        h = self.hosts[index]
+        if not h.up or version not in h.pulled:
+            return None
+        return self._pump_host(h, version)
+
+    def rollback_host(self, index: int) -> Optional[Dict]:
+        """One pump back toward the boot (version-less) store."""
+        h = self.hosts[index]
+        if not h.up:
+            return None
+        return self._pump_host(h, None)
+
+    def _pump_host(self, h: SimHost,
+                   version: Optional[str]) -> Dict:
+        """The agent's ``_pump_toward`` reduced to sim state: add ONE
+        warming target replica (bounded to +1 over the boot count),
+        then — once a target replica is ready — gracefully drain ONE
+        old replica, repeat.  Never drops below one ready replica;
+        draining replicas finish their queues (exactly-once)."""
+        per_host = max(int(self.cfg.crosshost.agent_replicas), 1)
+        tgt = [r for r in h.replicas
+               if r.version == version and r.state in (READY, WARMING)]
+        tgt_ready = [r for r in tgt if r.state == READY]
+        old = [r for r in h.replicas
+               if r.version != version and r.state in (READY, WARMING)]
+        added = swapped = None
+        if len(tgt) < per_host and len(tgt) + len(old) <= per_host:
+            r = SimReplica(self._next_rid(), h, state=WARMING,
+                           version=version)
+            h.replicas.append(r)
+            self.k.after(self.cfg.sim.warmup_s,
+                         lambda rr=r: self._replica_ready(rr))
+            added = r.rid
+            self.log("swap_add", host=h.name, replica=r.rid,
+                     version=version_label(version))
+        elif old and tgt_ready:
+            old_ready = [r for r in old if r.state == READY]
+            if old_ready:
+                victim = min(old_ready,
+                             key=lambda x: (x.depth(), x.rid))
+                victim.state = DRAINING
+                swapped = victim.rid
+                self.log("swap_drain", host=h.name,
+                         replica=victim.rid,
+                         version=version_label(victim.version))
+                self._maybe_finish_drain(victim)
+        remaining = sum(1 for r in h.replicas
+                        if r.version != version
+                        and r.state in (READY, WARMING, DRAINING))
+        pending = sum(1 for r in h.replicas
+                      if r.version == version and r.state == WARMING)
+        if remaining == 0 and pending == 0:
+            # swap complete: future scheduler resizes build this
+            # version (the live agent repoints manager._build_fn)
+            h.default_version = version
+        return {"remaining": remaining, "pending": pending,
+                "added": added, "swapped": swapped}
 
     # -- quiescence --------------------------------------------------------
 

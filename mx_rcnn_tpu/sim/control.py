@@ -43,8 +43,12 @@ from mx_rcnn_tpu.obs.collect import (Collector, RegistrySource,
                                      view_to_snapshot)
 from mx_rcnn_tpu.obs.health import CRITICAL, WARN, HealthEngine, Rule
 from mx_rcnn_tpu.obs.timeseries import TimeSeriesStore
+from mx_rcnn_tpu.serve.rollout import (DONE, ROLLED_BACK,
+                                       OnlinePairedGate,
+                                       RolloutController, rollout_rules,
+                                       version_label)
 from mx_rcnn_tpu.serve.scheduler import AgentAdminError, FleetScheduler
-from mx_rcnn_tpu.sim.cluster import SimCluster
+from mx_rcnn_tpu.sim.cluster import READY, SimCluster
 from mx_rcnn_tpu.sim.kernel import SimKernel
 from mx_rcnn_tpu.sim.score import score_run
 from mx_rcnn_tpu.sim.traffic import fleet_capacity_rps, rate_at
@@ -125,6 +129,67 @@ class SimAdmin:
         return result
 
 
+class SimRolloutPort:
+    """``RolloutController`` port over the simulated cluster — the
+    virtual-time twin of ``AgentRolloutPort``.  Down hosts answer None
+    on every verb (the controller's defer/re-converge machinery owns
+    retries), and the shadow-pair quality model is deterministic from
+    the kernel's ``shadow`` RNG substream: a healthy canary scores
+    IDENTICALLY to base (the same program on the same canvas — paired
+    deltas exactly zero), while the red-team arm's canary scores
+    ``rollout.redteam_damage`` lower plus small jitter, which is
+    exactly the kind of silent model damage only the paired gate can
+    see (latency and failure metrics stay clean)."""
+
+    def __init__(self, run: "SimRun", version: str, damage: float):
+        self.run = run
+        self.version = version
+        self.damage = float(damage)
+        self._rng = run.k.rng("shadow")
+
+    @staticmethod
+    def _index(source: str) -> int:
+        return int(source.rsplit("-", 1)[1])
+
+    def sources(self) -> List[str]:
+        return [h.name for h in self.run.cluster.hosts]
+
+    def pull(self, source: str, url: str, version: str):
+        return self.run.cluster.pull_version(self._index(source),
+                                             version)
+
+    def versions(self, source: str):
+        return self.run.cluster.host_versions(self._index(source))
+
+    def swap_next(self, source: str, version: str):
+        return self.run.cluster.swap_replica(self._index(source),
+                                             version)
+
+    def rollback(self, source: str):
+        return self.run.cluster.rollback_host(self._index(source))
+
+    def set_canary(self, version, fraction: float) -> None:
+        self.run.cluster.set_canary(version, fraction)
+
+    def shadow_pair(self):
+        for h in self.run.cluster.hosts:
+            if not h.up or self.version not in h.pulled:
+                continue
+            ready = [r for r in h.replicas if r.state == READY]
+            if not (any(r.version == self.version for r in ready)
+                    and any(r.version != self.version
+                            for r in ready)):
+                continue
+            base = 0.8 + 0.05 * float(self._rng.standard_normal())
+            if self.damage:
+                canary = (base - self.damage
+                          + 0.01 * float(self._rng.standard_normal()))
+            else:
+                canary = base
+            return (round(base, 6), round(canary, 6))
+        return None
+
+
 class SimRun:
     """One arm of the gauntlet: one trace, one config, one seed."""
 
@@ -149,7 +214,15 @@ class SimRun:
              for h in self.cluster.hosts]
             + [RegistrySource("head", lambda: (self.cluster.head, {}))],
             clock=self.k.clock)
-        self.engine = HealthEngine(sim_rules(self.cfg), self.store,
+        self._rollout_spec = trace.get("rollout")
+        self.rollout: Optional[RolloutController] = None
+        rules = sim_rules(self.cfg)
+        if self._rollout_spec:
+            # per-version canary SLO rules ride in the SAME engine the
+            # scorer reads: a canary breach is an SLO breach
+            rules = rules + rollout_rules(
+                self.cfg, self._rollout_spec["version"])
+        self.engine = HealthEngine(rules, self.store,
                                    clock=self.k.clock,
                                    on_transition=self._on_health)
         self.scheduler = FleetScheduler(self.store,
@@ -180,6 +253,25 @@ class SimRun:
         self._log("health", prev=prev, verdict=new,
                   firing=list(verdict["firing"]))
 
+    def _rollout_log(self, kind: str, **kw) -> None:
+        kw.pop("t", None)  # the harness stamps its own virtual time
+        self._log(f"rollout_{kind}", **kw)
+
+    def _start_rollout(self) -> None:
+        ro = self._rollout_spec
+        port = SimRolloutPort(self, ro["version"],
+                              self.cfg.rollout.redteam_damage)
+        self.rollout = RolloutController(
+            port, self.cfg, version=ro["version"],
+            store_url=ro.get("store_url", "sim://store"),
+            gate=OnlinePairedGate(
+                budget=self.cfg.rollout.gate_budget,
+                min_pairs=self.cfg.rollout.gate_min_pairs),
+            health=self.engine, clock=self.k.clock,
+            log=self._rollout_log)
+        self.scheduler.rollout = self.rollout
+        self.rollout.start()
+
     # -- the scrape/judge/act tick ----------------------------------------
 
     def _tick(self) -> None:
@@ -207,6 +299,9 @@ class SimRun:
             if "error" in action:
                 entry["error"] = action["error"]
             self._log("action", **entry)
+        if (self.rollout is not None
+                and self.rollout.phase not in (DONE, ROLLED_BACK)):
+            self.rollout.step()
         nxt = now + interval
         if nxt <= self.trace["duration_s"] + self.cfg.sim.settle_s:
             self.k.at(nxt, self._tick)
@@ -233,6 +328,9 @@ class SimRun:
     # -- trace events ------------------------------------------------------
 
     def _install_events(self) -> None:
+        if self._rollout_spec:
+            self.k.at(float(self._rollout_spec.get("t_start", 10.0)),
+                      self._start_rollout)
         for ev in self.trace.get("events", []):
             kind, host = ev["kind"], int(ev["host"])
             if kind == "host_down":
@@ -295,4 +393,22 @@ class SimRun:
                           self.cluster.wait_ms_max, p99, self.log)
         score["label"] = self.label
         score["events_fired"] = self.k.fired
+        if self.rollout is not None:
+            census: Dict[str, int] = {}
+            for h in self.cluster.hosts:
+                if not h.up:
+                    continue
+                for r in h.replicas:
+                    if r.state == READY:
+                        lbl = version_label(r.version)
+                        census[lbl] = census.get(lbl, 0) + 1
+            score["rollout"] = {
+                "phase": self.rollout.phase,
+                "reason": self.rollout._rollback_reason,
+                "rollback_s": self.rollout.rollback_s,
+                "gate": self.rollout.gate.verdict(),
+                "final_versions": census,
+                "per_version": {k: dict(v) for k, v in
+                                sorted(self.cluster.ver_stats.items())},
+            }
         return score

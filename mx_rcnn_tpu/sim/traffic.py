@@ -32,6 +32,15 @@ Generators (each deterministic in (hosts, cfg, seed)):
   stagger, under steady load.  The scheduler must NOT fight the update
   (the operator derates the target by the expected concurrent dip —
   the trace's override encodes that runbook step).
+* ``canary_rollout``— a ``"rollout"`` block starts the REAL
+  ``RolloutController`` (pull → canary + online paired gate → wave
+  rolling swaps → finalize) against the whole fleet under steady load,
+  with one host darkened mid-roll so FINALIZE must re-converge a
+  relaunched (boot-version) host.  Autoscaling is frozen for the
+  rollout window (the runbook step), and the red-team arm damages the
+  canary's shadow scores (``rollout__redteam_damage``) so only the
+  paired gate can catch it — the required outcome there is refusal +
+  auto-rollback, not completion.
 """
 
 from __future__ import annotations
@@ -44,7 +53,7 @@ from typing import Dict, List, Tuple
 from mx_rcnn_tpu.config import Config
 
 SCENARIOS = ("diurnal", "flash_crowd", "failure_storm",
-             "rolling_update")
+             "rolling_update", "canary_rollout")
 
 
 def bucket_weights(cfg: Config) -> List[Tuple[Tuple[int, int], float]]:
@@ -192,11 +201,70 @@ def gen_rolling_update(cfg: Config, hosts: int, seed: int) -> Dict:
     return _finalize(tr)
 
 
+def gen_canary_rollout(cfg: Config, hosts: int, seed: int) -> Dict:
+    cap = fleet_capacity_rps(cfg, hosts)
+    # wave width: roll ~1/6th of the fleet concurrently (floor 1) —
+    # the runbook wave a 100-host operator would pick
+    wave = max(1, min(16, hosts // 6))
+    per_host = max(int(cfg.crosshost.agent_replicas), 1)
+    interval = cfg.sim.scrape_interval_s
+    # duration floor from the rollout's own timeline: pull, canary
+    # capacity warm + gate samples + bake, the wave-rolled swaps, and
+    # a FINALIZE re-convergence of the darkened host — with 50% slack
+    t_start = 10.0
+    pull_s = cfg.rollout.pull_s + 2 * interval
+    # the canary replica warms while the bake clock and the per-tick
+    # gate samples run concurrently
+    canary_s = (max(cfg.rollout.bake_s,
+                    cfg.sim.warmup_s
+                    + cfg.rollout.gate_min_pairs * interval)
+                + 3 * interval)
+    roll_s = (math.ceil(hosts / wave)
+              * per_host * (cfg.sim.warmup_s + 2 * interval + 2.0))
+    final_s = (cfg.sim.relaunch_s + cfg.sim.warmup_s
+               + cfg.rollout.pull_s
+               + per_host * (cfg.sim.warmup_s + 3 * interval) + 10.0)
+    T = max(cfg.sim.duration_s,
+            round((t_start + pull_s + canary_s + roll_s + final_s)
+                  * 1.5, 0))
+    tr = _base("canary_rollout", cfg, hosts, seed, T)
+    tr["rate"] = [[0.0, round(cap * cfg.sim.util, 3)]]
+    # runbook: freeze autoscaling during the rollout window (both the
+    # deficit and the idle-drain signals) — the rollout's deliberate
+    # +1-replica overshoot and one-dark-host dip are planned states,
+    # not capacity incidents
+    tr["overrides"]["crosshost__for_samples"] = 100_000
+    tr["overrides"]["crosshost__idle_samples"] = 100_000
+    tr["overrides"]["rollout__wave"] = wave
+    # the canary lane must not exceed the canary arm's capacity share:
+    # `wave` hosts hold ONE canary replica each while the gate bakes,
+    # so a fraction above wave/(hosts*per_host) would overload them
+    # into watermark sheds and page the canary p99 rule on a perfectly
+    # healthy model (the runbook sizing step)
+    tr["overrides"]["rollout__canary_fraction"] = round(
+        min(cfg.rollout.canary_fraction,
+            wave / float(hosts * per_host)), 4)
+    # one gate sample per controller tick: the online gate judges on
+    # ~min_pairs virtual seconds instead of min_pairs x sample_every
+    tr["overrides"]["rollout__gate_sample_every"] = 1
+    # a darkened host relaunches on the boot version well inside the
+    # trace; a tighter step bound hands it to FINALIZE quickly
+    tr["overrides"]["rollout__step_timeout_s"] = 25.0
+    tr["rollout"] = {"version": "v2", "t_start": t_start}
+    # darken one early-rolled host mid-roll: it comes back on the boot
+    # store and FINALIZE must pull + swap it again (the convergence
+    # half of the kill-mid-rollout bar)
+    t_dark = round(t_start + pull_s + canary_s + roll_s * 0.3, 3)
+    tr["events"].append({"t": t_dark, "kind": "drain_host", "host": 0})
+    return _finalize(tr)
+
+
 GENERATORS = {
     "diurnal": gen_diurnal,
     "flash_crowd": gen_flash_crowd,
     "failure_storm": gen_failure_storm,
     "rolling_update": gen_rolling_update,
+    "canary_rollout": gen_canary_rollout,
 }
 
 

@@ -204,15 +204,12 @@ def summarize(records: List[Dict]) -> Dict[str, Dict]:
     return out
 
 
-# two-sided 97.5% Student-t quantiles, df 1..30 (NIST tables); scipy is
-# not a dependency.  df > 30 falls back to the df=30 value — slightly
-# WIDER than the true quantile, so the equivalence gate errs conservative
-_T975 = {1: 12.706, 2: 4.303, 3: 3.182, 4: 2.776, 5: 2.571,
-         6: 2.447, 7: 2.365, 8: 2.306, 9: 2.262, 10: 2.228,
-         11: 2.201, 12: 2.179, 13: 2.160, 14: 2.145, 15: 2.131,
-         16: 2.120, 17: 2.110, 18: 2.101, 19: 2.093, 20: 2.086,
-         21: 2.080, 22: 2.074, 23: 2.069, 24: 2.064, 25: 2.060,
-         26: 2.056, 27: 2.052, 28: 2.048, 29: 2.045, 30: 2.042}
+# the t table + CI + sign-test judgment lives in serve/rollout.py now:
+# the ONLINE canary gate must refuse a damaged v2 with the same math
+# this offline gauntlet uses, so the stats are one function both call
+# (kept importable here under the old name for existing callers)
+from mx_rcnn_tpu.serve.rollout import T975 as _T975  # noqa: E402
+from mx_rcnn_tpu.serve.rollout import paired_stats  # noqa: E402
 
 
 def paired_compare(records: List[Dict], mode_a: str, mode_b: str,
@@ -234,9 +231,10 @@ def paired_compare(records: List[Dict], mode_a: str, mode_b: str,
     * ``within_budget``: whether the CI lies inside ±``budget`` — the
       equivalence gate (CI-inside-bounds, i.e. TOST-style, NOT a mere
       failure-to-reject).
-    """
-    import math
 
+    The statistics themselves are ``serve/rollout.py paired_stats`` —
+    the same judgment the live canary gate applies online.
+    """
     a = {r["seed"]: r["mAP"] for r in records
          if r["mode"] == mode_a and r["network"] == network}
     b = {r["seed"]: r["mAP"] for r in records
@@ -250,33 +248,15 @@ def paired_compare(records: List[Dict], mode_a: str, mode_b: str,
             f"no common seeds between {mode_a!r} and {mode_b!r} "
             f"for network {network!r}")
     deltas = [round(b[s] - a[s], 4) for s in seeds]
-    n = len(deltas)
-    mean = float(np.mean(deltas))
-    if n >= 2:
-        sem = float(np.std(deltas, ddof=1)) / math.sqrt(n)
-        t = _T975.get(n - 1, _T975[30])
-        ci = (mean - t * sem, mean + t * sem)
-    else:
-        ci = None  # one seed proves nothing (and json has no Infinity)
-    pos = sum(d > 0 for d in deltas)
-    neg = sum(d < 0 for d in deltas)
-    m = pos + neg
-    # two-sided exact binomial sign test, p = P(#pos as or more extreme)
-    if m:
-        k = min(pos, neg)
-        tail = sum(math.comb(m, i) for i in range(k + 1)) / 2.0 ** m
-        sign_p = min(1.0, 2.0 * tail)
-    else:
-        sign_p = 1.0
+    st = paired_stats(deltas, budget)
     return {
         "compare": f"{mode_b}-vs-{mode_a}", "network": network,
         "seeds": seeds, "deltas": deltas,
-        "mean_delta": round(mean, 4),
-        "ci95": [round(ci[0], 4), round(ci[1], 4)] if ci else None,
-        "sign_test_p": round(sign_p, 4),
+        "mean_delta": st["mean_delta"],
+        "ci95": st["ci95"],
+        "sign_test_p": st["sign_test_p"],
         "budget": budget,
-        "within_budget": bool(ci is not None and -budget <= ci[0]
-                              and ci[1] <= budget),
+        "within_budget": st["within_budget"],
     }
 
 

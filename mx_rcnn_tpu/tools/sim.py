@@ -48,6 +48,15 @@ from mx_rcnn_tpu.sim.traffic import SCENARIOS, generate
 # reroute, expiry pressure)
 PIN_SCENARIO = "failure_storm"
 
+# per-scenario red-team arms.  canary_rollout's mistuned arm is not a
+# sabotaged scheduler but a DAMAGED MODEL: the canary's shadow scores
+# drop by redteam_damage while its latency/failure metrics stay clean,
+# so only the online paired gate can catch it.  The required mistuned
+# outcome there is refusal + auto-rollback (protection), not a breach.
+MISTUNED_BY_SCENARIO = {
+    "canary_rollout": {"rollout__redteam_damage": 0.35},
+}
+
 
 def _arm(trace: Dict, cfg, label: str,
          overrides: Optional[Dict] = None) -> Dict:
@@ -105,7 +114,9 @@ def run_gauntlet(scenarios: List[str], hosts: int, seed: int,
     for name in scenarios:
         trace = generate(name, cfg, hosts, seed)
         shipped = _arm(trace, cfg, "shipped")
-        mistuned = _arm(trace, cfg, "mistuned", MISTUNED_OVERRIDES)
+        mistuned = _arm(trace, cfg, "mistuned",
+                        MISTUNED_BY_SCENARIO.get(name,
+                                                 MISTUNED_OVERRIDES))
         out["scenarios"][name] = {
             "trace_fingerprint": trace["fingerprint"],
             "hosts": trace["hosts"],
@@ -152,6 +163,39 @@ def check_gauntlet(record: Dict) -> List[str]:
                 f"{shipped['failed']}) — must be 0")
         shipped_clean = (shipped["lost"] == 0
                          and shipped["slo_critical_minutes"] == 0)
+        ro_ship, ro_mis = shipped.get("rollout"), mistuned.get("rollout")
+        if ro_ship is not None:
+            # rollout rubric: the shipped (healthy-v2) arm must land
+            # the whole fleet on v2; the damaged-model arm must be
+            # REFUSED by the gate and auto-rolled back — and neither
+            # arm may lose a request while swapping under load
+            if ro_ship["phase"] != "done":
+                problems.append(f"{name}: shipped rollout ended in "
+                                f"phase {ro_ship['phase']!r}, not done")
+            elif set(ro_ship["final_versions"]) != {"v2"}:
+                problems.append(
+                    f"{name}: shipped fleet not converged on v2 "
+                    f"(ready versions: {ro_ship['final_versions']})")
+            if mistuned["lost"] != 0:
+                problems.append(f"{name}: mistuned (damaged-model) arm "
+                                f"LOST {mistuned['lost']} requests — "
+                                "rollback must not lose work")
+            if ro_mis is None or ro_mis["phase"] != "rolled_back":
+                problems.append(
+                    f"{name}: damaged-model arm was NOT rolled back "
+                    f"(phase {ro_mis and ro_mis['phase']!r})")
+            elif ro_mis["reason"] != "gate_refused":
+                problems.append(
+                    f"{name}: damaged-model rollback reason "
+                    f"{ro_mis['reason']!r}, expected gate_refused")
+            elif set(ro_mis["final_versions"]) != {"base"}:
+                problems.append(
+                    f"{name}: damaged-model fleet not restored to the "
+                    f"boot version (ready: {ro_mis['final_versions']})")
+            if (shipped_clean and ro_mis is not None
+                    and ro_mis.get("reason") == "gate_refused"):
+                breach += 1  # the gate IS the discrimination here
+            continue
         mistuned_breached = (mistuned["lost"] > 0
                              or mistuned["slo_critical_minutes"] > 0)
         if shipped_clean and mistuned_breached:

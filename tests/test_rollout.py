@@ -1,0 +1,628 @@
+"""Rollout-plane tests (ISSUE 18, docs/SERVING.md "Rollout tier").
+
+Pins the pieces the live smoke (``tools/rollout.py``) measures:
+
+* :func:`paired_stats` — the equivalence judgment the online canary
+  gate SHARES with ``tools/gauntlet.py paired_compare`` (CI-inside-
+  ±budget, exact sign test, n<2 never judges);
+* lineage admission — unknown-parent / unrooted / fingerprint-mismatch
+  refusals and the legacy version-less back-compat rule;
+* the bundled-weights round trip, including LEAF-LESS subtrees (a
+  BN-free model's empty ``batch_stats`` must survive the npz flatten —
+  the calling-convention regression the first live swap hit);
+* the router's deterministic canary lane and per-version exactly-once
+  accounting published into the scrape-visible registry;
+* :class:`RolloutController` over a fake port: happy path, gate-refusal
+  auto-rollback, rollback idempotence (including rolling back a fleet
+  that COMPLETED the swap), kill-mid-rollout deferral with FINALIZE
+  re-convergence and the bounded abandon grace;
+* the sim's canary_rollout scenario end to end in virtual time:
+  byte-reproducible decision log, shipped arm lands v2, red-team arm is
+  refused and rolled back.
+"""
+
+import math
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.obs.health import CRITICAL
+from mx_rcnn_tpu.obs.metrics import Registry
+from mx_rcnn_tpu.serve.export import (ExportMismatch, ExportStore,
+                                      variables_fingerprint)
+from mx_rcnn_tpu.serve.fleet import FleetRequest, FleetRouter
+from mx_rcnn_tpu.serve.rollout import (DONE, ROLLED_BACK, ROLLING_BACK,
+                                       OnlinePairedGate,
+                                       RolloutController, detection_score,
+                                       paired_stats, rollout_rules,
+                                       version_label)
+
+
+# ---------------------------------------------------------------------------
+# paired_stats — the shared gate/gauntlet judgment
+# ---------------------------------------------------------------------------
+
+class TestPairedStats:
+    def test_one_delta_proves_nothing(self):
+        for deltas in ([], [0.0]):
+            st = paired_stats(deltas, budget=0.02)
+            assert st["ci95"] is None
+            assert st["within_budget"] is False
+
+    def test_zero_deltas_pass_equivalence(self):
+        st = paired_stats([0.0] * 8, budget=0.02)
+        assert st["mean_delta"] == 0.0
+        assert st["ci95"] == [0.0, 0.0]
+        assert st["within_budget"] is True
+        assert st["sign_test_p"] == 1.0  # zeros dropped, no evidence
+
+    def test_damaged_arm_fails_equivalence_and_sign_test(self):
+        st = paired_stats([-0.7, -0.75, -0.8, -0.72, -0.78], budget=0.02)
+        assert st["within_budget"] is False
+        assert st["mean_delta"] < -0.5
+        # exact one-sided-extreme binomial: 2 * (1/2)^5
+        assert st["sign_test_p"] == pytest.approx(2 * 0.5 ** 5)
+
+    def test_ci_is_students_t_by_hand(self):
+        deltas = [0.01, -0.01, 0.02, 0.0]
+        st = paired_stats(deltas, budget=0.05)
+        mean = float(np.mean(deltas))
+        sem = float(np.std(deltas, ddof=1)) / math.sqrt(4)
+        lo, hi = mean - 3.182 * sem, mean + 3.182 * sem  # t.975 df=3
+        assert st["ci95"] == [round(lo, 4), round(hi, 4)]
+        assert st["within_budget"] == (-0.05 <= lo and hi <= 0.05)
+
+    def test_wide_ci_not_within_budget_even_with_zero_mean(self):
+        # equivalence is CI-inside-bounds, NOT failure-to-reject: a
+        # noisy symmetric sample with mean 0 must NOT pass
+        st = paired_stats([0.5, -0.5, 0.4, -0.4], budget=0.02)
+        assert abs(st["mean_delta"]) < 0.01
+        assert st["within_budget"] is False
+
+
+class TestDetectionScore:
+    def test_identical_arms_score_identically(self):
+        dets = {"cat": np.array([[0, 0, 10, 10, 0.9],
+                                 [1, 1, 5, 5, 0.8]])}
+        assert detection_score(dets) == detection_score(dict(dets))
+
+    def test_empty_scores_zero(self):
+        assert detection_score({}) == 0.0
+        assert detection_score({"cat": np.zeros((0, 5))}) == 0.0
+
+    def test_confidence_collapse_lowers_score(self):
+        strong = {"c": np.array([[0, 0, 9, 9, 0.95]])}
+        weak = {"c": np.array([[0, 0, 9, 9, 0.05]])}
+        assert detection_score(weak) < detection_score(strong)
+
+    def test_junk_box_explosion_lowers_score(self):
+        one = {"c": np.array([[0, 0, 9, 9, 0.9]])}
+        junk = {"c": np.tile([0, 0, 9, 9, 0.1], (50, 1))}
+        # a broken NMS floods low-confidence boxes: total confidence
+        # grows slower than the (1+count) normalizer, so the score
+        # drops below the single clean detection
+        assert detection_score(junk) < detection_score(one)
+
+
+class TestOnlinePairedGate:
+    def test_not_judged_below_min_pairs(self):
+        gate = OnlinePairedGate(budget=0.02, min_pairs=4)
+        for _ in range(3):
+            gate.add_pair(0.8, 0.8)
+        v = gate.verdict()
+        assert not v["judged"] and not v["refused"]
+
+    def test_healthy_canary_passes(self):
+        gate = OnlinePairedGate(budget=0.02, min_pairs=4)
+        for _ in range(4):
+            gate.add_pair(0.8, 0.8)
+        v = gate.verdict()
+        assert v["judged"] and not v["refused"]
+
+    def test_damaged_canary_refused(self):
+        gate = OnlinePairedGate(budget=0.02, min_pairs=4)
+        for _ in range(4):
+            gate.add_pair(0.8, 0.05)  # delta = canary - base < 0
+        v = gate.verdict()
+        assert v["judged"] and v["refused"]
+        assert v["mean_delta"] == pytest.approx(-0.75)
+
+
+class TestVersionLabel:
+    def test_base_for_versionless(self):
+        assert version_label(None) == "base"
+        assert version_label("") == "base"
+
+    def test_metric_unsafe_chars_sanitized(self):
+        assert version_label("v2@candidate/1") == "v2_candidate_1"
+
+    def test_rules_reference_labelled_series(self):
+        cfg = generate_config("tiny", "synthetic")
+        rules = rollout_rules(cfg, "v2")
+        metrics = " ".join(r.metric for r in rules)
+        assert "fleet.ver.v2.total_ms" in metrics
+        assert "fleet.ver.v2.failed/fleet.ver.v2.dispatched" in metrics
+        assert all(r.severity == CRITICAL for r in rules)
+
+
+# ---------------------------------------------------------------------------
+# lineage admission + the bundled-weights round trip
+# ---------------------------------------------------------------------------
+
+def _store_with_manifest(tmp_path, name, manifest):
+    root = tmp_path / name
+    root.mkdir()
+    store = ExportStore(str(root))
+    store._manifest = dict(manifest)
+    return store
+
+
+class TestLineage:
+    PARENT = "a" * 64
+
+    def _child(self, tmp_path, **extra):
+        m = {"kind": "mx_rcnn_tpu_export_store", "entries": {},
+             "version": "v2", "parent_sha": self.PARENT,
+             "train_fingerprint": "f" * 64}
+        m.update(extra)
+        return _store_with_manifest(tmp_path, "child", m)
+
+    def test_known_parent_admits(self, tmp_path):
+        got = self._child(tmp_path).check_lineage(
+            known_parents={self.PARENT})
+        assert got == {"version": "v2", "parent_sha": self.PARENT,
+                       "train_fingerprint": "f" * 64, "legacy": False}
+
+    def test_unknown_parent_refused(self, tmp_path):
+        with pytest.raises(ExportMismatch, match="unknown parent"):
+            self._child(tmp_path).check_lineage(known_parents={"b" * 64})
+
+    def test_unrooted_version_refused_when_lineage_required(self, tmp_path):
+        store = self._child(tmp_path, parent_sha=None)
+        with pytest.raises(ExportMismatch, match="unrooted"):
+            store.check_lineage(known_parents={self.PARENT})
+        # with no parent requirement the same store admits
+        assert store.check_lineage()["version"] == "v2"
+
+    def test_fingerprint_mismatch_refused(self, tmp_path):
+        with pytest.raises(ExportMismatch, match="train_fingerprint"):
+            self._child(tmp_path).check_lineage(
+                known_parents={self.PARENT},
+                expect_train_fingerprint="deadbeef" * 8)
+
+    def test_fingerprint_match_admits(self, tmp_path):
+        got = self._child(tmp_path).check_lineage(
+            known_parents={self.PARENT},
+            expect_train_fingerprint="f" * 64)
+        assert not got["legacy"]
+
+    def test_legacy_versionless_store_admits_unchanged(self, tmp_path):
+        # every store exported before the rollout plane: no "version"
+        # key at all — carries no claims, admits even under a required
+        # parent set (the quant-admission back-compat idiom)
+        store = _store_with_manifest(
+            tmp_path, "old", {"kind": "mx_rcnn_tpu_export_store",
+                              "entries": {}})
+        got = store.check_lineage(known_parents={self.PARENT},
+                                  expect_train_fingerprint="x" * 64)
+        assert got == {"version": None, "parent_sha": None,
+                       "legacy": True}
+
+
+class TestVariablesBundle:
+    def test_round_trip_preserves_empty_subtrees(self, tmp_path):
+        # a BN-free model's variables are {"params": ..., "batch_stats":
+        # {}} — the empty subtree has NO leaves, so a plain
+        # flatten→npz→unflatten drops it, and exported programs (traced
+        # WITH it) then refuse the pytree at call time.  The manifest's
+        # empty_subtrees record must rebuild it on load.
+        cfg = generate_config("tiny", "synthetic")
+        variables = {
+            "params": {"conv": {"kernel": np.ones((3, 3), np.float32),
+                                "bias": np.zeros((3,), np.float32)}},
+            "batch_stats": {},
+        }
+        root = str(tmp_path / "store")
+        store = ExportStore.create(root, cfg)
+        store.add_variables(variables)
+        store.finish()
+
+        loaded = ExportStore(root).load_variables()
+        assert loaded["batch_stats"] == {}
+        assert set(loaded) == {"params", "batch_stats"}
+        np.testing.assert_array_equal(
+            loaded["params"]["conv"]["kernel"],
+            variables["params"]["conv"]["kernel"])
+        # and the weights identity survives the trip
+        assert variables_fingerprint(loaded) == \
+            variables_fingerprint(variables)
+
+    def test_versioned_store_without_bundle_refuses(self, tmp_path):
+        store = _store_with_manifest(
+            tmp_path, "nobundle", {"entries": {}, "version": "v2"})
+        with pytest.raises(ExportMismatch, match="no variables payload"):
+            store.load_variables()
+
+
+# ---------------------------------------------------------------------------
+# canary lane + per-version accounting (the router half of the plane)
+# ---------------------------------------------------------------------------
+
+class TestCanaryLane:
+    def _make(self, registry=None):
+        cfg = generate_config("tiny", "synthetic")
+        return FleetRouter(SimpleNamespace(registry=registry), cfg)
+
+    def test_fraction_is_deterministic_accumulator(self):
+        router = self._make()
+        base = SimpleNamespace(version=None, id=0)
+        canary = SimpleNamespace(version="v2", id=1)
+        router.set_canary("v2", 0.25)
+        lanes = [router._canary_lane([base, canary]) for _ in range(8)]
+        picks = [lane == [canary] for lane in lanes]
+        # exactly 1-in-4, at requests 4 and 8 — not a coin flip
+        assert picks == [False, False, False, True,
+                         False, False, False, True]
+        assert all(lane == [base] for i, lane in enumerate(lanes)
+                   if not picks[i])
+
+    def test_clearing_the_lane_restores_version_blind_jsq(self):
+        router = self._make()
+        base = SimpleNamespace(version=None, id=0)
+        canary = SimpleNamespace(version="v2", id=1)
+        router.set_canary("v2", 1.0)
+        assert router._canary_lane([base, canary]) == [canary]
+        router.set_canary(None, 0.0)
+        assert router._canary_lane([base, canary]) == [base, canary]
+
+    def test_empty_lane_falls_back_and_is_counted(self):
+        # availability outranks canary purity: the canary fraction
+        # outrunning v2 capacity must never fail a servable request
+        router = self._make()
+        base = SimpleNamespace(version=None, id=0)
+        router.set_canary("v2", 1.0)
+        assert router._canary_lane([base]) == [base]
+        assert router.metrics.registry.counter(
+            "fleet.canary_fallback") == 1
+
+
+class TestPerVersionAccounting:
+    def _freq(self, version="v2"):
+        freq = FleetRequest(np.zeros((4, 4, 3), np.uint8), None, 0.0)
+        freq.replica_id = 0
+        freq.version = version
+        return freq
+
+    def test_counts_reach_the_scrape_visible_registry(self):
+        # an agent's canary series must land in the manager's (shared,
+        # scraped) registry — NOT the router's private fleet registry —
+        # or rollout_rules judge a series that never reaches /metrics
+        shared = Registry()
+        cfg = generate_config("tiny", "synthetic")
+        router = FleetRouter(SimpleNamespace(registry=shared), cfg)
+        router._count_version(self._freq(), "served", ms=12.0)
+        snap = shared.snapshot()
+        assert snap["counters"]["fleet.ver.v2.served"] == 1
+        assert "fleet.ver.v2.total_ms" in snap["hists"]
+        assert router.metrics.registry.counter("fleet.ver.v2.served") == 0
+
+    def test_falls_back_to_private_registry_in_process(self):
+        cfg = generate_config("tiny", "synthetic")
+        router = FleetRouter(SimpleNamespace(registry=None), cfg)
+        router._count_version(self._freq(version=None), "expired")
+        assert router.metrics.registry.counter(
+            "fleet.ver.base.expired") == 1
+
+    def test_undispatched_request_counts_nowhere(self):
+        # exactly-once per version is "of the LAST dispatch target":
+        # a request that never reached a replica has no version row
+        cfg = generate_config("tiny", "synthetic")
+        shared = Registry()
+        router = FleetRouter(SimpleNamespace(registry=shared), cfg)
+        freq = self._freq()
+        freq.replica_id = None
+        router._count_version(freq, "failed")
+        assert shared.snapshot()["counters"] == {}
+
+
+# ---------------------------------------------------------------------------
+# the controller over a fake port
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def tick(self, dt=1.0):
+        self.now += dt
+
+
+class FakeHost:
+    def __init__(self):
+        self.versions_d = {"base": 1}
+        self.pulls = 0
+        self.down = False
+
+
+class FakePort:
+    """Port-protocol fake: each host swaps base→v2 in two pump calls
+    (add the v2 replica, then drain base) — the same side-by-side
+    then-drain shape the real agent pump walks."""
+
+    def __init__(self, names, pair=(0.8, 0.8)):
+        self.hosts = {n: FakeHost() for n in names}
+        self.canary_calls = []
+        self.pair = pair
+
+    def sources(self):
+        return sorted(self.hosts)
+
+    def pull(self, source, url, version):
+        h = self.hosts[source]
+        if h.down:
+            return None
+        h.pulls += 1
+        return {"already": h.pulls > 1}
+
+    def versions(self, source):
+        h = self.hosts[source]
+        return None if h.down else dict(h.versions_d)
+
+    def swap_next(self, source, version):
+        h = self.hosts[source]
+        if h.down:
+            return None
+        if h.versions_d.get(version, 0) < 1:
+            h.versions_d[version] = 1
+            return {"added": 1, "remaining": 1, "pending": False}
+        if h.versions_d.get("base", 0) > 0:
+            del h.versions_d["base"]
+            return {"swapped": 1, "remaining": 0, "pending": False}
+        return {"remaining": 0, "pending": False}
+
+    def rollback(self, source):
+        h = self.hosts[source]
+        if h.down:
+            return None
+        h.versions_d = {"base": 1}
+        return {"remaining": 0, "pending": False}
+
+    def set_canary(self, version, fraction):
+        self.canary_calls.append((version, fraction))
+
+    def shadow_pair(self):
+        return self.pair
+
+
+def _cfg():
+    return generate_config(
+        "tiny", "synthetic", rollout__gate_min_pairs=3,
+        rollout__gate_sample_every=1, rollout__bake_s=4.0,
+        rollout__step_timeout_s=10.0, rollout__canary_fraction=0.25)
+
+
+def _drive(ctrl, clock, max_steps=200, on_tick=None):
+    steps = 0
+    while ctrl.phase not in (DONE, ROLLED_BACK) and steps < max_steps:
+        ctrl.step()
+        clock.tick(1.0)
+        steps += 1
+        if on_tick is not None:
+            on_tick(ctrl)
+    return ctrl.phase
+
+
+def _kinds(ctrl):
+    return [e["kind"] for e in ctrl.events]
+
+
+class TestController:
+    def test_happy_path_lands_v2_everywhere(self):
+        port = FakePort(["a", "b", "c"])
+        clock = FakeClock()
+        ctrl = RolloutController(port, _cfg(), version="v2",
+                                 clock=clock)
+        ctrl.start()
+        assert _drive(ctrl, clock) == DONE
+        for h in port.hosts.values():
+            assert h.versions_d == {"v2": 1}
+            assert h.pulls == 1  # one transfer per host, ever
+        kinds = _kinds(ctrl)
+        assert kinds[0] == "start" and kinds[-1] == "done"
+        assert kinds.count("pulled") == 3
+        assert kinds.count("host_rolled") == 3
+        assert "gate_passed" in kinds and "gate_refused" not in kinds
+        # lane opens at the canary fraction, closes at gate pass
+        assert port.canary_calls[0] == ("v2", 0.25)
+        assert (None, 0.0) in port.canary_calls[1:]
+        # rolling starts only after the gate verdict
+        assert kinds.index("gate_passed") < kinds.index("host_rolling")
+
+    def test_gate_refusal_auto_rolls_back(self):
+        port = FakePort(["a", "b"], pair=(0.8, 0.05))  # damaged canary
+        clock = FakeClock()
+        ctrl = RolloutController(port, _cfg(), version="v2",
+                                 clock=clock)
+        ctrl.start()
+        assert _drive(ctrl, clock) == ROLLED_BACK
+        assert ctrl._rollback_reason == "gate_refused"
+        assert ctrl.rollback_s is not None
+        v = ctrl.gate.verdict()
+        assert v["refused"] and v["mean_delta"] < -0.5
+        for h in port.hosts.values():
+            assert h.versions_d == {"base": 1}
+        kinds = _kinds(ctrl)
+        assert "gate_refused" in kinds and "rolled_back" in kinds
+        assert "host_rolling" not in kinds  # refused BEFORE rolling
+
+    def test_rollback_is_idempotent(self):
+        port = FakePort(["a"], pair=(0.8, 0.05))
+        clock = FakeClock()
+        ctrl = RolloutController(port, _cfg(), version="v2",
+                                 clock=clock)
+        ctrl.start()
+        _drive(ctrl, clock)
+        assert ctrl.phase == ROLLED_BACK
+        # operator on top of the gate's rollback: a recorded no-op
+        res = ctrl.rollback("operator")
+        assert res == {"phase": ROLLED_BACK, "noop": True}
+        assert ctrl._rollback_reason == "gate_refused"  # unchanged
+
+    def test_rollback_returns_a_completed_fleet_to_base(self):
+        # first-class rollback AFTER the swap completed: hosts hold
+        # ONLY v2 (no canary replica left) and must still pump back —
+        # the consistency check is "boot-only", not "holds canary"
+        port = FakePort(["a", "b"])
+        clock = FakeClock()
+        ctrl = RolloutController(port, _cfg(), version="v2",
+                                 clock=clock)
+        ctrl.start()
+        assert _drive(ctrl, clock) == DONE
+        res = ctrl.rollback("operator")
+        assert res["noop"] is False
+        while ctrl.phase == ROLLING_BACK:
+            ctrl.step()
+            clock.tick(1.0)
+        assert ctrl.phase == ROLLED_BACK
+        for h in port.hosts.values():
+            assert h.versions_d == {"base": 1}
+
+    def test_health_critical_rolls_back(self):
+        port = FakePort(["a"])
+        clock = FakeClock()
+        health = SimpleNamespace(verdict=CRITICAL)
+        ctrl = RolloutController(port, _cfg(), version="v2",
+                                 clock=clock, health=health)
+        ctrl.start()
+        assert _drive(ctrl, clock) == ROLLED_BACK
+        assert ctrl._rollback_reason == "health_critical"
+
+    def test_killed_host_defers_then_finalize_reconverges(self):
+        port = FakePort(["a", "b", "c"])
+        clock = FakeClock()
+        ctrl = RolloutController(port, _cfg(), version="v2",
+                                 clock=clock)
+        ctrl.start()
+
+        def kill_then_relaunch(c):
+            # SIGKILL "b" the moment it starts rolling; "relaunch" it
+            # (back on boot, pull state wiped like a fresh process)
+            # once the controller has deferred it
+            if (not port.hosts["b"].down
+                    and any(e["kind"] == "host_rolling"
+                            and e.get("source") == "b"
+                            for e in c.events)
+                    and "host_deferred" not in _kinds(c)):
+                port.hosts["b"].down = True
+                port.hosts["b"].versions_d = {"base": 1}
+            if "host_deferred" in _kinds(c):
+                port.hosts["b"].down = False
+
+        assert _drive(ctrl, clock, on_tick=kill_then_relaunch) == DONE
+        kinds = _kinds(ctrl)
+        assert "host_deferred" in kinds
+        assert "finalize_abandoned" not in kinds
+        for h in port.hosts.values():
+            assert h.versions_d == {"v2": 1}
+
+    def test_host_down_forever_is_abandoned_after_grace(self):
+        port = FakePort(["a", "b"])
+        clock = FakeClock()
+        ctrl = RolloutController(port, _cfg(), version="v2",
+                                 clock=clock)
+        ctrl.start()
+
+        def kill_for_good(c):
+            if any(e["kind"] == "host_rolling" and e.get("source") == "b"
+                   for e in c.events):
+                port.hosts["b"].down = True
+
+        assert _drive(ctrl, clock, on_tick=kill_for_good) == DONE
+        kinds = _kinds(ctrl)
+        assert "host_deferred" in kinds
+        assert "finalize_abandoned" in kinds
+        # the live fleet still converged — the down host is an
+        # operator problem, not a hung rollout
+        assert port.hosts["a"].versions_d == {"v2": 1}
+
+    def test_unpullable_host_defers_without_blocking_the_fleet(self):
+        port = FakePort(["a", "b"])
+        port.hosts["b"].down = True
+        clock = FakeClock()
+        ctrl = RolloutController(port, _cfg(), version="v2",
+                                 clock=clock)
+        ctrl.start()
+        assert _drive(ctrl, clock) == DONE
+        kinds = _kinds(ctrl)
+        assert "pull_deferred" in kinds
+        assert "finalize_abandoned" in kinds
+        assert port.hosts["a"].versions_d == {"v2": 1}
+        assert port.hosts["b"].pulls == 0
+
+    def test_decision_log_is_deterministic(self):
+        def one():
+            port = FakePort(["a", "b"])
+            clock = FakeClock()
+            ctrl = RolloutController(port, _cfg(), version="v2",
+                                     clock=clock)
+            ctrl.start()
+            _drive(ctrl, clock)
+            return ctrl.events
+
+        assert one() == one()
+
+
+# ---------------------------------------------------------------------------
+# the sim scenario: the REAL controller at fleet scale in virtual time
+# ---------------------------------------------------------------------------
+
+class TestSimCanaryRollout:
+    @pytest.fixture(scope="class")
+    def cfg(self):
+        return generate_config("tiny", "synthetic")
+
+    @pytest.fixture(scope="class")
+    def shipped(self, cfg):
+        from mx_rcnn_tpu.sim.control import SimRun
+        from mx_rcnn_tpu.sim.score import decision_log_bytes
+        from mx_rcnn_tpu.sim.traffic import generate
+        out = []
+        for _ in range(2):
+            run = SimRun(generate("canary_rollout", cfg, 6, seed=3),
+                         cfg, label="shipped")
+            score = run.run()
+            out.append((score, decision_log_bytes(run.log)))
+        return out
+
+    def test_shipped_lands_v2_with_zero_lost(self, shipped):
+        score, _ = shipped[0]
+        assert score["rollout"]["phase"] == "done"
+        assert score["lost"] == 0
+        assert score["submitted"] == (score["served"] + score["shed"]
+                                      + score["expired"]
+                                      + score["failed"])
+
+    def test_decision_log_byte_identical(self, shipped):
+        (s1, b1), (s2, b2) = shipped
+        assert b1 == b2
+        assert s1["decision_log_sha256"] == s2["decision_log_sha256"]
+
+    def test_redteam_arm_refused_and_rolled_back(self, cfg, shipped):
+        from mx_rcnn_tpu.sim.control import SimRun
+        from mx_rcnn_tpu.sim.traffic import generate
+        run = SimRun(generate("canary_rollout", cfg, 6, seed=3), cfg,
+                     label="mistuned",
+                     arm_overrides={"rollout__redteam_damage": 0.35})
+        score = run.run()
+        assert score["rollout"]["phase"] == "rolled_back"
+        assert score["rollout"]["reason"] == "gate_refused"
+        assert score["rollout"]["gate"]["refused"] is True
+        assert score["lost"] == 0  # refusal must not cost requests
+        # same trace, same seed: the divergence is the damage alone
+        assert score["decision_log_sha256"] != \
+            shipped[0][0]["decision_log_sha256"]
