@@ -17,7 +17,7 @@ NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 	obs-smoke perf-smoke elastic-smoke data-smoke fleet-smoke \
 	quant-smoke threadlint-smoke bulk-smoke crashsim-smoke \
 	health-smoke crosshost-smoke wirefuzz-smoke sim-smoke \
-	rollout-smoke clean
+	rollout-smoke trace-smoke clean
 
 all: native
 
@@ -212,6 +212,18 @@ threadlint-smoke:
 wirefuzz-smoke:
 	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.wirefuzz --smoke
 
+# distributed-tracing smoke (docs/OBSERVABILITY.md "Distributed
+# tracing"): the TRACE_r19 protocol against 2 stub-agent subprocesses —
+# a fully-sampled traced burst (every head-kept span tree must be 100%
+# complete and monotonic under the skew-corrected merge, with cross-host
+# spans and live skew estimates), a SIGKILL-reroute leg (both attempts
+# of a rerouted request visible as ONE two-attempt trace, served on the
+# survivor), and a traced-vs-untraced throughput A/B (overhead < 2%).
+# ~1 min.
+trace-smoke:
+	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.trace \
+		--smoke --check --out /tmp/mxrcnn_trace_smoke.json
+
 # fleet-simulator smoke (docs/SIM.md): the failure_storm scenario at
 # 100 hosts in virtual time — preemption sweep, crash-loop flappers
 # under the shipped RestartPolicy, deficit-driven re-placement, then a
@@ -259,11 +271,12 @@ elastic-smoke:
 # ~2 min), the quantized-inference smoke (quant-smoke, ~2 min), the
 # elastic shrink/grow storm (elastic-smoke, ~3 min), the
 # sanitizer-armed serve+elastic re-run (threadlint-smoke, ~4 min) and
-# the wire-protocol fuzz of the cross-host plane (wirefuzz-smoke, ~1 min)
-test-gate: lint crashsim-smoke wirefuzz-smoke sim-smoke serve-smoke \
-		perf-smoke obs-smoke health-smoke data-smoke fleet-smoke \
-		crosshost-smoke bulk-smoke quant-smoke ft-smoke elastic-smoke \
-		rollout-smoke threadlint-smoke
+# the wire-protocol fuzz of the cross-host plane (wirefuzz-smoke,
+# ~1 min) and the distributed-tracing protocol (trace-smoke, ~1 min)
+test-gate: lint crashsim-smoke wirefuzz-smoke trace-smoke sim-smoke \
+		serve-smoke perf-smoke obs-smoke health-smoke data-smoke \
+		fleet-smoke crosshost-smoke bulk-smoke quant-smoke ft-smoke \
+		elastic-smoke rollout-smoke threadlint-smoke
 	python -m pytest tests/ -x -q -m "gate"
 
 clean:

@@ -702,6 +702,19 @@ class ObsConfig:
     # comma-separated /metrics URLs (host:port or full URL, optionally
     # name=url) merged into the labeled fleet view by tools/obs.py
     collect_urls: str = ""
+    # --- distributed tracing (obs/trace.py distributed plane) ------------
+    # head sampling probability for cross-host traces (0 = off: the
+    # serve hot path pays one None-check and wire frames stay
+    # bit-identical to the untraced layout — pinned by
+    # tests/test_trace_distributed.py).  Deterministic fraction
+    # accumulator, not a coin flip.
+    trace_sample: float = 0.0
+    trace_ring: int = 256            # kept span trees per process
+    # forced tail retention: SERVED traces in the slowest percentile of
+    # the recent window are kept alongside every non-SERVED/rerouted one
+    trace_slow_pct: float = 99.0
+    # obs.skew_ms.max drift alarm threshold (obs/health.py skew rule)
+    skew_alarm_ms: float = 50.0
 
 
 @dataclass(frozen=True)

@@ -120,6 +120,30 @@ def check_timeout_ms(value, what: str = "timeout_ms"):
     return t
 
 
+# trace-context header bound (obs/trace.py TRACE_HEADER): the value is
+# a short structured string; anything longer is hostile, not a trace
+MAX_TRACE_HEADER = 256
+
+
+def check_trace_header(value, what: str = "X-MXR-Trace"):
+    """Pre-validate a wire-supplied trace-context header before it
+    reaches the parser: ``None`` passes through (untraced request —
+    the back-compat path), anything else must be a short ascii string.
+    Malformation is a typed 400 (BodyError), NEVER a silently dropped
+    or zero-filled context — a peer that SENT a context must learn it
+    was unusable (the netio rejection contract applied to tracing)."""
+    if value is None:
+        return None
+    if not isinstance(value, str) or len(value) > MAX_TRACE_HEADER:
+        raise BodyError(400, f"{what} header missing or over "
+                             f"{MAX_TRACE_HEADER} chars")
+    try:
+        value.encode("ascii")
+    except UnicodeEncodeError:
+        raise BodyError(400, f"{what} header is not ascii")
+    return value
+
+
 def read_request_body(handler, max_bytes: int,
                       deadline_s: float = None) -> bytes:
     """Read one HTTP request body off ``handler`` (a

@@ -189,6 +189,12 @@ class Collector:
                  clock: Callable[[], float] = time.time):
         self._lock = threading.Lock()
         self._sources: Dict[str, object] = {}
+        # head-local gauge callables (no registry of their own): each
+        # returns {name: value} folded into agg.gauges under the "head"
+        # source on every collect — how the skew estimator's
+        # obs.skew_ms.* gauges reach the timeseries store without a
+        # dedicated registry (serve/remote.py — RemoteBacklogFeed)
+        self._gauge_fns: List[Callable[[], Dict[str, float]]] = []
         # view-timestamp clock: wall time by default, virtual under sim/
         self._clock = clock
         for s in sources or []:
@@ -197,6 +203,10 @@ class Collector:
     def add(self, source) -> None:
         with self._lock:
             self._sources[source.name] = source
+
+    def add_gauge_fn(self, fn: Callable[[], Dict[str, float]]) -> None:
+        with self._lock:
+            self._gauge_fns.append(fn)
 
     def remove(self, name: str) -> None:
         with self._lock:
@@ -209,6 +219,7 @@ class Collector:
     def collect(self) -> Dict:
         with self._lock:
             sources = list(self._sources.values())
+            gauge_fns = list(self._gauge_fns)
         view: Dict = {"ts": round(self._clock(), 6), "sources": {}}
         agg_counters: Dict[str, float] = {}
         agg_gauges: Dict[str, Dict[str, float]] = {}
@@ -230,6 +241,14 @@ class Collector:
                 agg_counters[k] = agg_counters.get(k, 0) + v
             for k, v in snap.get("gauges", {}).items():
                 agg_gauges.setdefault(k, {})[src.name] = v
+        for fn in gauge_fns:
+            try:
+                extra = fn()
+            except Exception:
+                logger.exception("obs collect: gauge fn failed")
+                continue
+            for k, v in (extra or {}).items():
+                agg_gauges.setdefault(k, {})["head"] = float(v)
         view["up"] = up
         view["agg"] = {"counters": agg_counters, "gauges": agg_gauges}
         return view

@@ -83,11 +83,16 @@ class FlightRecorder:
 
     def __init__(self, store: TimeSeriesStore, run_dir: str,
                  window_s: float = 120.0, max_events: int = 512,
-                 span_tail: int = 500, min_gap_s: float = 5.0):
+                 span_tail: int = 500, min_gap_s: float = 5.0,
+                 trace_tree_tail: int = 32):
         self.store = store
         self.flight_dir = os.path.join(run_dir, "flight")
         self.window_s = float(window_s)
         self.span_tail = int(span_tail)
+        # schema /2: the distributed trace ring's last-N kept span
+        # trees ride the dump (a postmortem names the slow/failed
+        # requests, not just the aggregate window)
+        self.trace_tree_tail = int(trace_tree_tail)
         self.min_gap_s = float(min_gap_s)
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=int(max_events))
@@ -139,7 +144,9 @@ class FlightRecorder:
             events = list(self._events)
             context_fns = dict(self._context)
         record: Dict = {
-            "schema": "mx_rcnn_tpu.flight/1",
+            # /2: adds "trace_trees" — the distributed trace ring's last
+            # N kept span trees (absent when the plane is unarmed)
+            "schema": "mx_rcnn_tpu.flight/2",
             "reason": reason,
             "ts": round(time.time(), 6),
             "pid": os.getpid(),
@@ -155,6 +162,14 @@ class FlightRecorder:
                 record["spans"] = obs_trace.events()[-self.span_tail:]
         except Exception:
             logger.exception("obs flight: span capture failed")
+        try:
+            from mx_rcnn_tpu.obs import trace as obs_trace
+
+            if obs_trace.ring() is not None:
+                record["trace_trees"] = obs_trace.kept_trees(
+                    limit=self.trace_tree_tail)
+        except Exception:
+            logger.exception("obs flight: trace-tree capture failed")
         ctx: Dict = {}
         for name, fn in context_fns.items():
             try:

@@ -290,6 +290,14 @@ def default_rules(cfg) -> List[Rule]:
         # step loop for a macroscopic pause
         Rule("snapshot-stall", "snapshot.stall_ms", "p99", ">",
              1000.0, window_s=120.0, severity=WARN),
+        # clock-skew drift: the head's NTP-style per-agent offset
+        # estimate (obs/trace.py — SkewEstimator, exported through the
+        # crosshost feed as obs.skew_ms.*).  Past the alarm bound the
+        # merged fleet timelines stop being trustworthy — the doctor
+        # still clamps children into parents, but attribution across
+        # hosts degrades from measurement to estimate
+        Rule("trace-skew-drift", "obs.skew_ms.max", "gauge_max", ">",
+             float(cfg.obs.skew_alarm_ms), window_s=w, severity=WARN),
     ]
 
 

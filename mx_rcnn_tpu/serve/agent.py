@@ -47,13 +47,14 @@ import numpy as np
 
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.netio import (BodyError, check_timeout_ms,
-                               read_limited,
+                               check_trace_header, read_limited,
                                read_request_body)
+from mx_rcnn_tpu.obs import trace as obs_trace
 from mx_rcnn_tpu.obs.metrics import LoweringCounter, Registry
 from mx_rcnn_tpu.serve.export import MANIFEST_NAME
 from mx_rcnn_tpu.serve.queue import (DeadlineExceeded, RequestFailed,
                                      ShedError)
-from mx_rcnn_tpu.serve.remote import (decode_prepared, encode_result,
+from mx_rcnn_tpu.serve.remote import (decode_prepared_ex, encode_result,
                                       normalize_agent_url)
 
 logger = logging.getLogger("mx_rcnn_tpu")
@@ -299,6 +300,11 @@ class ReplicaAgent:
                              replicas=max(1, cfg.crosshost.agent_replicas))
         self.cfg = cfg
         self.class_names = class_names
+        # arm the distributed span ring: agents obey the INBOUND sampled
+        # bit (no local sampling decision), so only the ring + tail knobs
+        # apply here — the head owns obs.trace_sample
+        obs_trace.configure_distributed(ring=cfg.obs.trace_ring,
+                                        slow_pct=cfg.obs.trace_slow_pct)
         self.registry = registry if registry is not None else Registry()
         self.store_pull: Optional[Dict] = None
         export_root = cfg.fleet.export_dir or None
@@ -629,26 +635,59 @@ class _AgentHandler(BaseHTTPRequestHandler):
         return read_request_body(self, self.server.max_body_bytes,
                                  self.server.body_deadline_s)
 
+    def _inbound_ctx(self) -> Optional["obs_trace.TraceContext"]:
+        """Parse the ``X-MXR-Trace`` header (JSON verbs).  Absent →
+        None (untraced — the back-compat path); malformed → ValueError
+        out of parse_header, which the POST error ladder maps to 400
+        (typed rejection, never a zero-filled context)."""
+        hdr = check_trace_header(self.headers.get(obs_trace.TRACE_HEADER))
+        return obs_trace.parse_header(hdr) if hdr is not None else None
+
+    def _close_agent_trace(self, actx, root_sid: int, parent: int,
+                           t_recv_us: int, outcome: str) -> None:
+        """Record this hop's root span ("agent.request" — every local
+        span nests under it) and keep the finished tree in the ring
+        (the /trace surface)."""
+        t_send = obs_trace.epoch_us()
+        obs_trace.record_span(
+            actx, "agent.request", (t_send - t_recv_us) / 1e3,
+            span_id=root_sid, parent=parent, t1_us=t_send,
+            outcome=outcome)
+        obs_trace.close_trace(actx, keep=True)
+
     def _wait_and_reply(self, req, timeout_ms: float, binary: bool,
-                        raw_dets: bool = False) -> None:
+                        raw_dets: bool = False, ctx=None,
+                        root_sid: int = 0, t_recv_us: int = 0) -> None:
         """Block the handler thread on the request handle and map its
         terminal state to the serve/server.py status contract (429
-        shed / 504 expired / 500 failed)."""
+        shed / 504 expired / 500 failed).  ``ctx`` (the inbound trace
+        context) makes the binary reply carry the skew-stamp extension
+        and closes this hop's span tree."""
         budget = (timeout_ms / 1000.0 + 10.0) if timeout_ms else 60.0
+        actx = ctx.child(root_sid) if ctx is not None else None
         try:
             dets = req.wait(timeout=budget)
-        except ShedError:
-            self._reply_json(429, {"error": "shed"})
-            return
-        except DeadlineExceeded:
-            self._reply_json(504, {"error": "deadline expired"})
-            return
-        except (RequestFailed, TimeoutError) as e:
-            self._reply_json(500, {"error": str(e)})
+        except (ShedError, DeadlineExceeded, RequestFailed,
+                TimeoutError) as e:
+            status = {ShedError: 429, DeadlineExceeded: 504}.get(
+                type(e), 500)
+            if actx is not None:
+                self._close_agent_trace(actx, root_sid, ctx.parent,
+                                        t_recv_us, type(e).__name__)
+            self._reply_json(status, {"error": str(e) or "shed"})
             return
         if binary:
-            self._reply_frame(encode_result(dets))
-        elif raw_dets:
+            ts_pair = None
+            if actx is not None:
+                self._close_agent_trace(actx, root_sid, ctx.parent,
+                                        t_recv_us, "served")
+                ts_pair = (t_recv_us, obs_trace.epoch_us())
+            self._reply_frame(encode_result(dets, ts_pair=ts_pair))
+            return
+        if actx is not None:
+            self._close_agent_trace(actx, root_sid, ctx.parent,
+                                    t_recv_us, "served")
+        if raw_dets:
             self._reply_json(200, {"dets_b64": {
                 int(c): base64.b64encode(
                     np.ascontiguousarray(a, np.float32).tobytes()).decode()
@@ -668,6 +707,14 @@ class _AgentHandler(BaseHTTPRequestHandler):
             elif self.path == "/metrics":
                 self._reply_json(200, {"registry":
                                        agent.metrics_snapshot()})
+            elif self.path.startswith("/trace"):
+                # the remote half of merge_fleet_trace: this host's kept
+                # span trees + its clock, so the head can sanity-check
+                # its skew estimate against a direct stamp
+                self._reply_json(200, {
+                    "host": obs_trace.host_label(),
+                    "clock_us": obs_trace.epoch_us(),
+                    "trees": obs_trace.kept_trees()})
             else:
                 self._reply_json(404, {"error": f"no route {self.path}"})
         except Exception as e:
@@ -678,16 +725,33 @@ class _AgentHandler(BaseHTTPRequestHandler):
         agent = self.server.agent
         try:
             if self.path == "/prepared":
+                t_recv_us = obs_trace.epoch_us()
                 buf = self._read_body()
+                d0 = time.monotonic()
                 try:
-                    data, im_info, timeout_ms = decode_prepared(buf)
+                    data, im_info, timeout_ms, ctx = \
+                        decode_prepared_ex(buf)
                 except ValueError as e:
                     self._reply_json(400, {"error": str(e)})
                     return
+                actx = None
+                root_sid = 0
+                if ctx is not None:
+                    root_sid = obs_trace.new_span_id()
+                    actx = ctx.child(root_sid)
+                    obs_trace.record_span(
+                        actx, "agent.decode",
+                        (time.monotonic() - d0) * 1e3,
+                        bytes=len(buf))
                 req = agent.router.submit_prepared(
-                    data, im_info, data.shape[:2], timeout_ms=timeout_ms)
-                self._wait_and_reply(req, timeout_ms, binary=True)
+                    data, im_info, data.shape[:2], timeout_ms=timeout_ms,
+                    tctx=actx)
+                self._wait_and_reply(req, timeout_ms, binary=True,
+                                     ctx=ctx, root_sid=root_sid,
+                                     t_recv_us=t_recv_us)
             elif self.path == "/prepared_json":
+                t_recv_us = obs_trace.epoch_us()
+                ctx = self._inbound_ctx()
                 body = json.loads(self._read_body().decode())
                 if not isinstance(body, dict):
                     raise ValueError("body must be a JSON object")
@@ -697,34 +761,62 @@ class _AgentHandler(BaseHTTPRequestHandler):
                     np.float32).reshape(shape)
                 timeout_ms = check_timeout_ms(
                     body.get("timeout_ms") or 0.0)
+                root_sid = obs_trace.new_span_id() if ctx is not None \
+                    else 0
                 req = agent.router.submit_prepared(
                     data, np.asarray(body["im_info"], np.float32),
-                    shape[:2], timeout_ms=timeout_ms)
+                    shape[:2], timeout_ms=timeout_ms,
+                    tctx=ctx.child(root_sid) if ctx is not None else None)
                 self._wait_and_reply(req, timeout_ms, binary=False,
-                                     raw_dets=True)
+                                     raw_dets=True, ctx=ctx,
+                                     root_sid=root_sid,
+                                     t_recv_us=t_recv_us)
             elif self.path == "/detect":
                 from mx_rcnn_tpu.serve.server import decode_image_payload
 
+                t_recv_us = obs_trace.epoch_us()
+                ctx = self._inbound_ctx()
                 body = json.loads(self._read_body().decode())
                 if not isinstance(body, dict):
                     raise ValueError("body must be a JSON object")
                 img = decode_image_payload(body)
                 timeout_ms = check_timeout_ms(
                     body.get("timeout_ms") or 0.0)
-                req = agent.router.submit(img, timeout_ms=timeout_ms)
+                root_sid = obs_trace.new_span_id() if ctx is not None \
+                    else 0
+                req = agent.router.submit(
+                    img, timeout_ms=timeout_ms,
+                    tctx=ctx.child(root_sid) if ctx is not None else None)
                 self._wait_and_reply(req, timeout_ms, binary=False,
-                                     raw_dets=bool(body.get("raw_dets")))
+                                     raw_dets=bool(body.get("raw_dets")),
+                                     ctx=ctx, root_sid=root_sid,
+                                     t_recv_us=t_recv_us)
             elif self.path == "/replicas":
+                t_recv_us = obs_trace.epoch_us()
+                ctx = self._inbound_ctx()
                 body = json.loads(self._read_body().decode() or "{}")
                 if not isinstance(body, dict):
                     raise ValueError("body must be a JSON object")
-                self._reply_json(200, agent.resize(
-                    target=body.get("target"), delta=body.get("delta")))
+                res = agent.resize(
+                    target=body.get("target"), delta=body.get("delta"))
+                if ctx is not None:
+                    root_sid = obs_trace.new_span_id()
+                    self._close_agent_trace(
+                        ctx.child(root_sid), root_sid, ctx.parent,
+                        t_recv_us, "agent.resize")
+                self._reply_json(200, res)
             elif self.path == "/rollout":
+                t_recv_us = obs_trace.epoch_us()
+                ctx = self._inbound_ctx()
                 body = json.loads(self._read_body().decode() or "{}")
                 if not isinstance(body, dict):
                     raise ValueError("body must be a JSON object")
                 op = body.get("op")
+                if ctx is not None:
+                    root_sid = obs_trace.new_span_id()
+                    self._close_agent_trace(
+                        ctx.child(root_sid), root_sid, ctx.parent,
+                        t_recv_us, f"agent.rollout.{op}")
                 if op == "pull":
                     self._reply_json(200, agent.rollout_pull(
                         body.get("url"), body.get("version")))

@@ -117,12 +117,15 @@ class ServingEngine:
         return data, im_info, bucket
 
     def submit(self, img: np.ndarray,
-               timeout_ms: float = None) -> ServeRequest:
+               timeout_ms: float = None,
+               tctx: "obs_trace.TraceContext" = None) -> ServeRequest:
         """Admit one image; returns the request handle immediately.
         The handle terminates as SERVED / SHED / EXPIRED / FAILED —
         ``handle.wait()`` blocks and raises the matching error class.
         ``timeout_ms`` overrides ``cfg.serve.default_timeout_ms``
-        (0 = no deadline)."""
+        (0 = no deadline).  ``tctx`` attaches an inbound distributed
+        trace context (None — the default — costs one None-check
+        downstream, nothing more)."""
         from mx_rcnn_tpu.data.image import estimate_bucket
 
         now = time.monotonic()
@@ -140,6 +143,7 @@ class ServingEngine:
         if self._closed or (len(self.queues[rough_bucket])
                             >= self.queues[rough_bucket].shed_watermark):
             req = ServeRequest(None, None, rough_bucket, deadline, now)
+            req.tctx = tctx
             self._trace_admit(req)
             self.metrics.count("submitted")
             req._finish(SHED)
@@ -147,6 +151,7 @@ class ServingEngine:
             return req
         data, im_info, bucket = self.preprocess(img)
         req = ServeRequest(data, im_info, bucket, deadline, now)
+        req.tctx = tctx
         self._trace_admit(req)
         self.metrics.count("submitted")
         if self._closed or not self.queues[bucket].offer(req):
@@ -156,7 +161,9 @@ class ServingEngine:
 
     def submit_prepared(self, data: np.ndarray, im_info: np.ndarray,
                         bucket: Tuple[int, int],
-                        timeout_ms: float = None) -> ServeRequest:
+                        timeout_ms: float = None,
+                        tctx: "obs_trace.TraceContext" = None
+                        ) -> ServeRequest:
         """Bulk-plane admission seam (``serve/bulk.py``): admit one
         ALREADY-preprocessed image — ``data`` is the (bh, bw, 3) fp32
         padded canvas exactly as :meth:`preprocess` would produce it
@@ -184,6 +191,7 @@ class ServingEngine:
         deadline = now + t / 1000.0 if t and t > 0 else None
         req = ServeRequest(data, np.asarray(im_info, np.float32), bucket,
                            deadline, now)
+        req.tctx = tctx
         self._trace_admit(req)
         self.metrics.count("submitted")
         if self._closed or not self.queues[bucket].offer(req):
@@ -302,6 +310,13 @@ class ServingEngine:
                     obs_trace.complete("serve.queue_wait",
                                        (now - r.enqueue_t) * 1e3,
                                        trace_id=r.trace_id)
+                if r.tctx is not None:
+                    # distributed lane-wait hop (per rider: admission →
+                    # batch collection), under the inbound context
+                    obs_trace.record_span(
+                        r.tctx, "serve.lane_wait",
+                        (now - r.enqueue_t) * 1e3,
+                        bucket=f"{bucket[0]}x{bucket[1]}")
             images, im_info = self._compose(bucket, reqs)
             t0 = time.monotonic()
             if tracing:
@@ -313,9 +328,18 @@ class ServingEngine:
                     boxes_b, scores_b, keep_b = self._run(images, im_info)
             else:
                 boxes_b, scores_b, keep_b = self._run(images, im_info)
+            batch_ms = (time.monotonic() - t0) * 1e3
             self.metrics.observe_batch(len(reqs),
                                        self.cfg.serve.batch_size,
-                                       (time.monotonic() - t0) * 1e3)
+                                       batch_ms)
+            for r in reqs:
+                if r.tctx is not None:
+                    # distributed compute hop: the rider's share of the
+                    # micro-batch dispatch+forward+postprocess interval
+                    obs_trace.record_span(
+                        r.tctx, "serve.compute", batch_ms,
+                        rows=len(reqs),
+                        bucket=f"{bucket[0]}x{bucket[1]}")
             for j, r in enumerate(reqs):
                 # deadline re-check at completion: a request alive when
                 # collected can expire during the coalescing window or the

@@ -48,6 +48,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from mx_rcnn_tpu.config import Config
+from mx_rcnn_tpu.obs import trace as obs_trace
 from mx_rcnn_tpu.obs.metrics import Registry, ServeMetrics
 from mx_rcnn_tpu.obs.metrics import registry as process_registry
 from mx_rcnn_tpu.serve.engine import ServingEngine
@@ -102,7 +103,8 @@ class FleetRequest(ServeRequest):
     a drained burst holds no pixel memory.
     """
 
-    __slots__ = ("attempts", "tried", "replica_id", "prepared", "version")
+    __slots__ = ("attempts", "tried", "replica_id", "prepared", "version",
+                 "tparent")
 
     def __init__(self, image: np.ndarray, deadline: Optional[float],
                  now: float, im_info: np.ndarray = None,
@@ -119,6 +121,11 @@ class FleetRequest(ServeRequest):
         # through ``ServingEngine.submit_prepared`` (a reroute re-offers
         # the same canvas; there is no raw image to re-resize)
         self.prepared = prepared
+        # distributed tracing: the span id this request's root span
+        # nests under (0 = head-originated; inbound contexts carry the
+        # upstream parent).  ``tctx``'s own parent is the ROOT span id
+        # every attempt/terminal span nests under.
+        self.tparent = 0
 
 
 class Replica:
@@ -510,6 +517,12 @@ class FleetRouter:
         self.cfg = cfg
         self.metrics = metrics or FleetMetrics()
         self._rr = itertools.count()  # JSQ tie-break rotation
+        # distributed tracing plane: the router owns the head's sampling
+        # decision (obs.trace_sample; 0 keeps the hot path at exactly
+        # one None-check per seam and wire frames bit-identical)
+        obs_trace.configure_distributed(
+            sample=cfg.obs.trace_sample, ring=cfg.obs.trace_ring,
+            slow_pct=cfg.obs.trace_slow_pct)
         # canary version lane (rollout plane): (version, fraction) or
         # None; the fraction accumulator makes lane choice DETERMINISTIC
         # (request k goes canary iff floor(k·f) > floor((k−1)·f)), so
@@ -589,21 +602,27 @@ class FleetRouter:
     # ------------------------------------------------------------------
 
     def submit(self, img: np.ndarray,
-               timeout_ms: float = None) -> FleetRequest:
+               timeout_ms: float = None,
+               tctx: "obs_trace.TraceContext" = None) -> FleetRequest:
         """Admit one image fleet-wide; returns the fleet handle (same
-        wait()/state contract as ``ServingEngine.submit``)."""
+        wait()/state contract as ``ServingEngine.submit``).  ``tctx``
+        is an INBOUND distributed trace context (the /detect header);
+        None lets the head's own sampler decide."""
         now = time.monotonic()
         t = (self.cfg.serve.default_timeout_ms if timeout_ms is None
              else timeout_ms)
         deadline = now + t / 1000.0 if t and t > 0 else None
         freq = FleetRequest(img, deadline, now)
+        self._trace_admit(freq, tctx)
         self.metrics.count("submitted")
         self._dispatch(freq)
         return freq
 
     def submit_prepared(self, data: np.ndarray, im_info: np.ndarray,
                         bucket: Tuple[int, int],
-                        timeout_ms: float = None) -> FleetRequest:
+                        timeout_ms: float = None,
+                        tctx: "obs_trace.TraceContext" = None
+                        ) -> FleetRequest:
         """Bulk-plane admission (``serve/bulk.py``): route one
         ALREADY-preprocessed canvas into its bucket lane fleet-wide —
         same JSQ spread, deadline authority, reroute and exactly-once
@@ -616,9 +635,45 @@ class FleetRouter:
         freq = FleetRequest(np.asarray(data), deadline, now,
                             im_info=np.asarray(im_info, np.float32),
                             bucket=tuple(bucket), prepared=True)
+        self._trace_admit(freq, tctx)
         self.metrics.count("submitted")
         self._dispatch(freq)
         return freq
+
+    @staticmethod
+    def _trace_admit(freq: FleetRequest,
+                     tctx: "obs_trace.TraceContext") -> None:
+        """Attach the request's distributed trace root: an inbound
+        context is adopted (its parent becomes the root span's parent),
+        otherwise the head's deterministic sampler decides.  Untraced
+        requests leave ``freq.tctx`` None — the whole hot-path cost."""
+        if tctx is None:
+            tctx = obs_trace.sample_trace()
+        if tctx is None:
+            return
+        root_sid = obs_trace.new_span_id()
+        freq.tparent = tctx.parent
+        # every attempt/terminal span nests under the root span id
+        freq.tctx = obs_trace.TraceContext(tctx.trace_id, root_sid,
+                                           tctx.hop, tctx.sampled)
+
+    def _finish_trace(self, freq: FleetRequest, state: str) -> None:
+        """Close the request's trace at its (exactly-once) fleet
+        terminal: record the root "request" span, then apply the tail
+        retention policy — forced keep for every non-SERVED or rerouted
+        request, slowest-percentile keep for the rest."""
+        ctx = freq.tctx
+        if ctx is None:
+            return
+        total_ms = (freq.done_t - freq.enqueue_t) * 1e3
+        obs_trace.record_span(ctx, "request", total_ms,
+                              span_id=ctx.parent, parent=freq.tparent,
+                              state=state, attempts=freq.attempts)
+        keep = obs_trace.retain_trace(state.upper(), total_ms=total_ms,
+                                      attempts=freq.attempts)
+        obs_trace.close_trace(ctx, keep=keep, state=state,
+                              attempts=freq.attempts,
+                              total_ms=round(total_ms, 3))
 
     def detect(self, img: np.ndarray, timeout_ms: float = None):
         req = self.submit(img, timeout_ms=timeout_ms)
@@ -660,6 +715,7 @@ class FleetRouter:
             if freq._finish(EXPIRED):
                 self.metrics.count("expired")
                 self._count_version(freq, "expired")
+                self._finish_trace(freq, EXPIRED)
                 freq.image = None
             return
         cands = [r for r in self.manager.ready_replicas()
@@ -671,6 +727,7 @@ class FleetRouter:
             if freq._finish(FAILED, error=err):
                 self.metrics.count("failed")
                 self._count_version(freq, "failed")
+                self._finish_trace(freq, FAILED)
                 freq.image = None
             return
         cands = self._canary_lane(cands)
@@ -699,10 +756,24 @@ class FleetRouter:
             return
         remaining_ms = (0.0 if freq.deadline is None
                         else max((freq.deadline - now) * 1000.0, 0.001))
+        # per-attempt trace context: each dispatch gets its own
+        # "fleet.attempt" span under the root, so a reroute-after-kill
+        # reconstructs as ONE trace with both attempt subtrees
+        inner_ctx = (freq.tctx.child(obs_trace.new_span_id())
+                     if freq.tctx is not None else None)
         if freq.prepared:
-            inner = eng.submit_prepared(freq.image, freq.im_info,
-                                        freq.bucket,
-                                        timeout_ms=remaining_ms)
+            if inner_ctx is not None:
+                inner = eng.submit_prepared(freq.image, freq.im_info,
+                                            freq.bucket,
+                                            timeout_ms=remaining_ms,
+                                            tctx=inner_ctx)
+            else:
+                inner = eng.submit_prepared(freq.image, freq.im_info,
+                                            freq.bucket,
+                                            timeout_ms=remaining_ms)
+        elif inner_ctx is not None:
+            inner = eng.submit(freq.image, timeout_ms=remaining_ms,
+                               tctx=inner_ctx)
         else:
             inner = eng.submit(freq.image, timeout_ms=remaining_ms)
         inner.add_done_callback(
@@ -718,6 +789,15 @@ class FleetRouter:
         terminates after dispatch, so fleet accounting mirrors the
         per-request exactly-once guarantee."""
         state = inner.state
+        if inner.tctx is not None:
+            # the attempt span: one per dispatch, nesting under the
+            # root — its id is the parent every replica-side span of
+            # this attempt carries
+            obs_trace.record_span(
+                freq.tctx, "fleet.attempt",
+                (inner.done_t - inner.enqueue_t) * 1e3,
+                span_id=inner.tctx.parent, replica=freq.replica_id,
+                attempt=freq.attempts, state=state)
         if state == SERVED:
             freq.batch_rows = inner.batch_rows
             if freq._finish(SERVED, result=inner.result):
@@ -725,6 +805,7 @@ class FleetRouter:
                 self.metrics.count("served")
                 self.metrics.observe("total_ms", ms)
                 self._count_version(freq, "served", ms=ms)
+                self._finish_trace(freq, SERVED)
                 freq.image = None
         elif state == SHED:
             if eng is not None and eng._closed:
@@ -738,11 +819,13 @@ class FleetRouter:
             if freq._finish(SHED):
                 self.metrics.count("shed")
                 self._count_version(freq, "shed")
+                self._finish_trace(freq, SHED)
                 freq.image = None
         elif state == EXPIRED:
             if freq._finish(EXPIRED):
                 self.metrics.count("expired")
                 self._count_version(freq, "expired")
+                self._finish_trace(freq, EXPIRED)
                 freq.image = None
         else:  # FAILED — replica died under it, or the batch errored
             self._retry_or_fail(freq, inner)
@@ -759,6 +842,7 @@ class FleetRouter:
             if freq._finish(EXPIRED):
                 self.metrics.count("expired")
                 self._count_version(freq, "expired")
+                self._finish_trace(freq, EXPIRED)
                 freq.image = None
             return
         if freq.attempts < 1 + max(self.cfg.fleet.reroute_retries, 0):
@@ -767,6 +851,7 @@ class FleetRouter:
         elif freq._finish(FAILED, error=inner.error):
             self.metrics.count("failed")
             self._count_version(freq, "failed")
+            self._finish_trace(freq, FAILED)
             freq.image = None
 
     # ------------------------------------------------------------------

@@ -66,7 +66,8 @@ class ServeRequest:
 
     __slots__ = ("image", "im_info", "bucket", "enqueue_t", "deadline",
                  "state", "result", "error", "dispatch_t", "done_t",
-                 "batch_rows", "trace_id", "_event", "_lock", "_on_done")
+                 "batch_rows", "trace_id", "tctx", "_event", "_lock",
+                 "_on_done")
 
     def __init__(self, image: np.ndarray, im_info: np.ndarray,
                  bucket: Tuple[int, int], deadline: Optional[float],
@@ -83,6 +84,7 @@ class ServeRequest:
         self.done_t: Optional[float] = None
         self.batch_rows = 0         # real rows in the micro-batch served with
         self.trace_id = None        # obs/trace.py context id (None = off)
+        self.tctx = None            # distributed TraceContext (None = off)
         self._event = threading.Event()
         self._lock = threading.Lock()
         self._on_done = None        # fleet router hook (add_done_callback)
@@ -101,6 +103,13 @@ class ServeRequest:
             # the respond hop: closes the async interval opened at
             # admission, from WHICHEVER thread terminated the request
             obs_trace.async_end("serve.request", self.trace_id, state=state)
+        if self.tctx is not None:
+            # distributed terminal audit: every terminal transition is
+            # exactly one terminal span — exactly-once accounting
+            # becomes trace-auditable (tests/test_trace_distributed.py)
+            obs_trace.record_span(
+                self.tctx, f"terminal.{state}", 0.0,
+                total_ms=round((self.done_t - self.enqueue_t) * 1e3, 3))
         self._event.set()
         cb = self._on_done
         if cb is not None:

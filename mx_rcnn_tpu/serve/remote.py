@@ -63,6 +63,7 @@ import numpy as np
 
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.netio import check_timeout_ms, read_limited
+from mx_rcnn_tpu.obs import trace as obs_trace
 from mx_rcnn_tpu.obs.metrics import Registry, ServeMetrics
 from mx_rcnn_tpu.serve.fleet import Replica
 from mx_rcnn_tpu.serve.queue import (EXPIRED, FAILED, SERVED, SHED,
@@ -87,56 +88,100 @@ logger = logging.getLogger("mx_rcnn_tpu")
 WIRE_MAGIC = b"MXR1"
 RESULT_MAGIC = b"MXD1"
 WIRE_VERSION = 1
+# result frame version carrying the trace extension (agent receive/send
+# epoch-µs stamps after the entries).  A version-1 result stays exactly
+# the PR-15 layout; agents only emit version 2 to a head that SENT a
+# trace context, so an old head never sees bytes it cannot decode.
+WIRE_VERSION_TRACED = 2
+# request-frame flags (the previously-reserved header field).  0 keeps
+# the frame bit-identical to the PR-15 layout; bit 0 declares a trace
+# context extension appended after the canvas payload.  Unknown bits
+# are typed-rejected — a length the head and agent disagree on must
+# never be zero-filled into a "valid" frame.
+WIRE_F_TRACE = 0x1
 _REQ_HEAD = struct.Struct("<4sHHHHHf3f")
 _RESP_HEAD = struct.Struct("<4sHH")
 _RESP_ENTRY = struct.Struct("<HI")
+_RESP_TRACE_EXT = struct.Struct("<QQ")   # agent recv / send (epoch µs)
 
 
 def encode_prepared(data: np.ndarray, im_info: np.ndarray,
-                    timeout_ms: float) -> bytes:
+                    timeout_ms: float,
+                    ctx: "obs_trace.TraceContext" = None) -> bytes:
     """(bh, bw, 3) fp32 canvas + (3,) im_info → one request frame.
     The payload is the array's raw C-order bytes — encode/decode is a
-    memcpy, and the agent reconstructs a bit-identical array."""
+    memcpy, and the agent reconstructs a bit-identical array.
+
+    ``ctx=None`` (the untraced default) produces bytes BIT-IDENTICAL to
+    the pre-trace layout (flags field 0, nothing appended — pinned by
+    tests/test_trace_distributed.py); a trace context appends the
+    compact extension blob and sets the flag bit."""
     a = np.ascontiguousarray(data, dtype=np.float32)
     if a.ndim != 3:
         raise ValueError(f"prepared frame wants (h, w, c), got {a.shape}")
     h, w, c = a.shape
     info = np.asarray(im_info, np.float32).reshape(3)
-    head = _REQ_HEAD.pack(WIRE_MAGIC, WIRE_VERSION, h, w, c, 0,
+    flags = 0 if ctx is None else WIRE_F_TRACE
+    head = _REQ_HEAD.pack(WIRE_MAGIC, WIRE_VERSION, h, w, c, flags,
                           float(timeout_ms or 0.0),
                           float(info[0]), float(info[1]), float(info[2]))
-    return head + a.tobytes()
+    if ctx is None:
+        return head + a.tobytes()
+    return head + a.tobytes() + obs_trace.encode_ctx(ctx)
 
 
-def decode_prepared(buf: bytes) -> Tuple[np.ndarray, np.ndarray, float]:
-    """Request frame → (canvas, im_info, timeout_ms); raises ValueError
-    on any malformed frame (bad magic/version/length) so the agent can
-    answer 400 instead of crashing a handler."""
+def decode_prepared_ex(buf: bytes) -> Tuple[np.ndarray, np.ndarray,
+                                            float,
+                                            Optional["obs_trace.TraceContext"]]:
+    """Request frame → (canvas, im_info, timeout_ms, trace_ctx | None);
+    raises ValueError on any malformed frame (bad magic/version/length/
+    flags/extension) so the agent can answer 400 instead of crashing a
+    handler.  Flag-less frames (the PR-15 layout) decode unchanged with
+    ctx None — back-compat is a pinned contract, and a malformed trace
+    extension REJECTS the frame rather than degrading to untraced."""
     if len(buf) < _REQ_HEAD.size:
         raise ValueError(f"frame truncated at {len(buf)} bytes")
-    (magic, ver, h, w, c, _rsvd, timeout_ms,
+    (magic, ver, h, w, c, flags, timeout_ms,
      i0, i1, i2) = _REQ_HEAD.unpack_from(buf)
     if magic != WIRE_MAGIC:
         raise ValueError(f"bad frame magic {magic!r}")
     if ver != WIRE_VERSION:
         raise ValueError(f"unsupported wire version {ver}")
+    if flags & ~WIRE_F_TRACE:
+        raise ValueError(f"unknown frame flags {flags:#x}")
     # a flipped bit in the timeout float must not smuggle inf/NaN into
     # deadline arithmetic (inf reaches Condition.wait as OverflowError)
     check_timeout_ms(timeout_ms)
     want = _REQ_HEAD.size + h * w * c * 4
-    if len(buf) != want:
+    ctx = None
+    if flags & WIRE_F_TRACE:
+        if len(buf) <= want:
+            raise ValueError("frame flags declare a trace extension "
+                             "but none is present")
+        ctx = obs_trace.decode_ctx(buf[want:])  # validates its own length
+    elif len(buf) != want:
         raise ValueError(f"frame is {len(buf)} bytes, header asks {want}")
     data = np.frombuffer(buf, np.float32,
                          count=h * w * c, offset=_REQ_HEAD.size)
     data = data.reshape(h, w, c).copy()  # own the memory (buf is transient)
-    return data, np.array([i0, i1, i2], np.float32), float(timeout_ms)
+    return data, np.array([i0, i1, i2], np.float32), float(timeout_ms), ctx
 
 
-def encode_result(dets: Dict[int, np.ndarray]) -> bytes:
+def decode_prepared(buf: bytes) -> Tuple[np.ndarray, np.ndarray, float]:
+    """PR-15 decode surface (canvas, im_info, timeout_ms) — same
+    validation as :func:`decode_prepared_ex`, trace context dropped."""
+    return decode_prepared_ex(buf)[:3]
+
+
+def encode_result(dets: Dict[int, np.ndarray],
+                  ts_pair: Tuple[float, float] = None) -> bytes:
     """{class_id: (k, 5) fp32} → one result frame (raw fp32 rows — the
     head decodes arrays bit-identical to what the remote demux
-    produced)."""
-    parts = [_RESP_HEAD.pack(RESULT_MAGIC, WIRE_VERSION, len(dets))]
+    produced).  ``ts_pair`` (agent receive/send epoch-µs stamps, set
+    only when the request carried a trace context) appends the skew
+    extension and bumps the frame to WIRE_VERSION_TRACED."""
+    ver = WIRE_VERSION if ts_pair is None else WIRE_VERSION_TRACED
+    parts = [_RESP_HEAD.pack(RESULT_MAGIC, ver, len(dets))]
     for cid in sorted(dets):
         arr = np.ascontiguousarray(dets[cid], dtype=np.float32)
         if arr.ndim != 2 or arr.shape[1] != 5:
@@ -144,18 +189,24 @@ def encode_result(dets: Dict[int, np.ndarray]) -> bytes:
                              f"got {arr.shape}")
         parts.append(_RESP_ENTRY.pack(int(cid), arr.shape[0]))
         parts.append(arr.tobytes())
+    if ts_pair is not None:
+        parts.append(_RESP_TRACE_EXT.pack(int(ts_pair[0]),
+                                          int(ts_pair[1])))
     return b"".join(parts)
 
 
-def decode_result(buf: bytes) -> Dict[int, np.ndarray]:
-    """Result frame → {class_id: (k, 5) fp32}; ValueError on malformed
-    frames."""
+def decode_result_ex(buf: bytes) -> Tuple[Dict[int, np.ndarray],
+                                          Optional[Tuple[float, float]]]:
+    """Result frame → ({class_id: (k, 5) fp32}, ts_pair | None);
+    ValueError on malformed frames.  Version 1 (untraced) must end
+    exactly at the last entry; version 2 must carry exactly the 16-byte
+    skew extension after the entries."""
     if len(buf) < _RESP_HEAD.size:
         raise ValueError(f"result truncated at {len(buf)} bytes")
     magic, ver, n = _RESP_HEAD.unpack_from(buf)
     if magic != RESULT_MAGIC:
         raise ValueError(f"bad result magic {magic!r}")
-    if ver != WIRE_VERSION:
+    if ver not in (WIRE_VERSION, WIRE_VERSION_TRACED):
         raise ValueError(f"unsupported wire version {ver}")
     off = _RESP_HEAD.size
     out: Dict[int, np.ndarray] = {}
@@ -170,9 +221,25 @@ def decode_result(buf: bytes) -> Dict[int, np.ndarray]:
         out[cid] = np.frombuffer(buf, np.float32, count=k * 5,
                                  offset=off).reshape(k, 5).copy()
         off += nbytes
+    ts_pair = None
+    if ver == WIRE_VERSION_TRACED:
+        if len(buf) - off != _RESP_TRACE_EXT.size:
+            raise ValueError(
+                f"traced result wants a {_RESP_TRACE_EXT.size}-byte "
+                f"skew extension, found {len(buf) - off} bytes")
+        t1, t2 = _RESP_TRACE_EXT.unpack_from(buf, off)
+        if t2 < t1:
+            raise ValueError("skew extension send stamp precedes receive")
+        ts_pair = (float(t1), float(t2))
+        off = len(buf)
     if off != len(buf):
         raise ValueError(f"{len(buf) - off} trailing bytes after result")
-    return out
+    return out, ts_pair
+
+
+def decode_result(buf: bytes) -> Dict[int, np.ndarray]:
+    """PR-15 decode surface — same validation, ts pair dropped."""
+    return decode_result_ex(buf)[0]
 
 
 def normalize_agent_url(url: str) -> str:
@@ -265,7 +332,9 @@ class RemoteEngine:
 
     def submit_prepared(self, data: np.ndarray, im_info: np.ndarray,
                         bucket: Tuple[int, int],
-                        timeout_ms: float = None) -> ServeRequest:
+                        timeout_ms: float = None,
+                        tctx: "obs_trace.TraceContext" = None
+                        ) -> ServeRequest:
         bucket = tuple(bucket)
         if tuple(data.shape) != bucket + (3,):
             raise ValueError(f"prepared data shape {tuple(data.shape)} "
@@ -279,10 +348,12 @@ class RemoteEngine:
         deadline = now + t / 1000.0 if t and t > 0 else None
         req = ServeRequest(data, np.asarray(im_info, np.float32), bucket,
                            deadline, now)
+        req.tctx = tctx
         return self._admit(req, "prepared")
 
     def submit(self, img: np.ndarray,
-               timeout_ms: float = None) -> ServeRequest:
+               timeout_ms: float = None,
+               tctx: "obs_trace.TraceContext" = None) -> ServeRequest:
         """Raw-image control path: ships JSON to the agent's /detect
         (the agent preprocesses server-side — same pixels as local
         serving by construction)."""
@@ -298,6 +369,7 @@ class RemoteEngine:
                                  self.cfg.bucket.shapes)
         req = ServeRequest(np.ascontiguousarray(img), None, bucket,
                            deadline, now)
+        req.tctx = tctx
         return self._admit(req, "detect")
 
     def _admit(self, req: ServeRequest, kind: str) -> ServeRequest:
@@ -358,10 +430,21 @@ class RemoteEngine:
             return
         remaining_ms = ((req.deadline - now) * 1000.0
                         if req.deadline is not None else 0.0)
+        # trace shipping: allocate the wire span HERE so the agent's
+        # root span can parent under it; the untraced path pays exactly
+        # one None-check (pinned by tests/test_trace_distributed.py)
+        ctx = req.tctx
+        wire_sid = 0
+        ship_ctx = None
+        headers = {"Content-Type": "application/json"}
+        if ctx is not None:
+            wire_sid = obs_trace.new_span_id()
+            ship_ctx = ctx.child(wire_sid)
         if kind == "prepared" and self.wire == "binary":
             path = "/prepared"
-            body = encode_prepared(req.image, req.im_info, remaining_ms)
-            ctype = "application/x-mxrcnn-frame"
+            body = encode_prepared(req.image, req.im_info, remaining_ms,
+                                   ctx=ship_ctx)
+            headers = {"Content-Type": "application/x-mxrcnn-frame"}
         elif kind == "prepared":  # the JSON/base64 A/B control arm
             path = "/prepared_json"
             body = json.dumps({
@@ -371,7 +454,6 @@ class RemoteEngine:
                 "im_info": [float(v) for v in req.im_info],
                 "timeout_ms": remaining_ms,
             }).encode()
-            ctype = "application/json"
         else:  # detect: raw image JSON control path
             body = json.dumps({
                 "pixels_b64": base64.b64encode(req.image.tobytes()).decode(),
@@ -380,7 +462,10 @@ class RemoteEngine:
                 "raw_dets": True,
             }).encode()
             path = "/detect"
-            ctype = "application/json"
+        if ship_ctx is not None and "json" in headers["Content-Type"]:
+            headers[obs_trace.TRACE_HEADER] = \
+                obs_trace.format_header(ship_ctx)
+        t0_us = obs_trace.epoch_us() if ctx is not None else 0
         # one transparent retry on a fresh connection: a keep-alive
         # socket the agent's server idled out raises on the FIRST write
         # after reuse — that is connection staleness, not host death
@@ -388,8 +473,7 @@ class RemoteEngine:
         for attempt in (0, 1):
             try:
                 conn = self._get_conn(holder)
-                conn.request("POST", path, body=body,
-                             headers={"Content-Type": ctype})
+                conn.request("POST", path, body=body, headers=headers)
                 resp = conn.getresponse()
                 payload = read_limited(resp, self._max_body,
                                        "agent response")
@@ -398,38 +482,70 @@ class RemoteEngine:
                 if attempt == 0 and not req.expired(time.monotonic()):
                     continue
                 self._note_transport(ok=False)
+                if ctx is not None:
+                    t3_us = obs_trace.epoch_us()
+                    obs_trace.record_span(
+                        ctx, "remote.wire", (t3_us - t0_us) / 1e3,
+                        span_id=wire_sid, t1_us=t3_us,
+                        engine=self.name, outcome="transport_error")
                 self._terminate(req, FAILED,
                                 error=RemoteTransportError(
                                     f"{self.agent_url}{path}: {e}"))
                 return
             self._note_transport(ok=True)
-            self._finish_from_response(req, kind, resp.status, payload)
+            self._finish_from_response(req, kind, resp.status, payload,
+                                       ctx=ctx, wire_sid=wire_sid,
+                                       t0_us=t0_us)
             return
 
     def _finish_from_response(self, req: ServeRequest, kind: str,
-                              status: int, payload: bytes) -> None:
+                              status: int, payload: bytes,
+                              ctx: "obs_trace.TraceContext" = None,
+                              wire_sid: int = 0, t0_us: int = 0) -> None:
+        t3_us = obs_trace.epoch_us() if ctx is not None else 0
+        dets = None
+        decode_err = None
         try:
             if status == 200:
                 if kind == "prepared" and self.wire == "binary":
-                    dets = decode_result(payload)
+                    dets, ts_pair = decode_result_ex(payload)
+                    if ctx is not None and ts_pair is not None:
+                        # NTP-style skew sample from the (t0, t1, t2, t3)
+                        # stamp quartet riding this response
+                        obs_trace.skew().note(self.name, t0_us,
+                                              ts_pair[0], ts_pair[1],
+                                              t3_us)
                 else:
                     body = json.loads(payload.decode())
                     dets = {int(c): np.asarray(
                         np.frombuffer(base64.b64decode(rows), np.float32)
                         .reshape(-1, 5))
                         for c, rows in body["dets_b64"].items()}
-                self._terminate(req, SERVED, result=dets)
-            elif status == 429:
-                self._terminate(req, SHED)
-            elif status == 504:
-                self._terminate(req, EXPIRED)
-            else:
-                err = RemoteTransportError(
-                    f"agent answered {status}: {payload[:200]!r}")
-                self._terminate(req, FAILED, error=err)
         except Exception as e:  # undecodable 200 body
+            decode_err = e
+            status = -1
+        # the wire span must land BEFORE _terminate: terminating fires
+        # the fleet completion chain, which closes (keeps/drops) the
+        # whole trace — a span recorded after close would re-open a ring
+        # entry that never closes and vanish from every kept tree
+        if ctx is not None:
+            obs_trace.record_span(
+                ctx, "remote.wire", (t3_us - t0_us) / 1e3,
+                span_id=wire_sid, t1_us=t3_us,
+                engine=self.name, status=int(status))
+        if decode_err is not None:
             self._terminate(req, FAILED, error=RemoteTransportError(
-                f"bad response payload: {e}"))
+                f"bad response payload: {decode_err}"))
+        elif status == 200:
+            self._terminate(req, SERVED, result=dets)
+        elif status == 429:
+            self._terminate(req, SHED)
+        elif status == 504:
+            self._terminate(req, EXPIRED)
+        else:
+            err = RemoteTransportError(
+                f"agent answered {status}: {payload[:200]!r}")
+            self._terminate(req, FAILED, error=err)
 
     def _terminate(self, req: ServeRequest, state: str, result=None,
                    error=None) -> None:
@@ -676,6 +792,10 @@ class RemoteBacklogFeed:
         # scheduler would read a saturated burst as "idle"
         sources.append(RegistrySource("head", router.metrics.registry))
         self.collector = Collector(sources)
+        # per-agent clock-offset gauges (obs.skew_ms.*): estimated by
+        # the head's SkewEstimator off traced result frames, folded in
+        # here so the drift alarm rule can judge them from the store
+        self.collector.add_gauge_fn(obs_trace.skew_gauges)
         self.store = store if store is not None else TimeSeriesStore(
             capacity=cfg.obs.ts_capacity)
         self._stop = threading.Event()

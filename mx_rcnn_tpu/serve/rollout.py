@@ -62,6 +62,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from mx_rcnn_tpu.obs.health import CRITICAL, Rule
+from mx_rcnn_tpu.obs.trace import correlation_id
 
 # rollout phases (the controller's whole state machine)
 IDLE = "idle"
@@ -258,16 +259,36 @@ class RolloutController:
 
     # ------------------------------------------------------------------
 
+    def _corr(self) -> str:
+        """Correlation id of the health-sample window this decision
+        reacted to: the attached HealthEngine's latest verdict stamp
+        when one exists (linking gate refusals / health rollbacks to
+        the triggering window), else the controller's own clock.  Both
+        are the injected clock under the simulator, so sim decision
+        logs stay byte-reproducible."""
+        ts = None
+        if self.health is not None:
+            try:
+                last = self.health.last()
+                if last:
+                    ts = last.get("ts")
+            except Exception:
+                ts = None
+        if ts is None:
+            ts = float(self._clock())
+        return correlation_id(ts)
+
     def _log(self, kind: str, **kw) -> None:
         ev = {"kind": kind, "t": round(float(self._clock()), 3),
-              "phase": self.phase, **kw}
+              "phase": self.phase, "corr": self._corr(), **kw}
         self.events.append(ev)
         if self._log_fn is not None:
             self._log_fn(kind, **{k: v for k, v in ev.items()
                                   if k != "kind"})
         if self._record is not None:
             try:
-                self._record.event(f"rollout_{kind}", **kw)
+                self._record.event(f"rollout_{kind}", corr=ev["corr"],
+                                   **kw)
             except Exception:
                 pass
 

@@ -57,6 +57,7 @@ from typing import Dict, List, Optional
 
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.netio import read_limited
+from mx_rcnn_tpu.obs import trace as obs_trace
 from mx_rcnn_tpu.obs.timeseries import TimeSeriesStore
 from mx_rcnn_tpu.serve.remote import normalize_agent_url
 
@@ -74,6 +75,10 @@ _AGENT_SRC = re.compile(r"^agent-\d+$")
 def _latest(store: TimeSeriesStore) -> Optional[Dict]:
     w = store.window(None)
     return w[-1] if w else None
+
+
+# decision-log correlation ids live with the rest of the tracing plane
+correlation_id = obs_trace.correlation_id
 
 
 def per_agent_ready(sample: Dict) -> Dict[str, float]:
@@ -197,7 +202,8 @@ class SchedulerPolicy:
             self._cooldown_until = now + cooldown_s
             self._deficit_streak = self._over_streak = 0
             self._idle_streak = 0
-            action.update(ready=ready, target=self.target)
+            action.update(ready=ready, target=self.target,
+                          corr=correlation_id(sample["ts"]))
             return action
 
         if self._deficit_streak >= ch.for_samples:
@@ -273,9 +279,17 @@ class AgentAdmin:
         """One admin RPC with the typed-failure contract: timeout →
         :class:`AgentAdminTimeout`, anything else (refused socket,
         non-200, undecodable body) → :class:`AgentAdminError`."""
+        headers = {"Content-Type": "application/json"}
+        # control-plane verbs carry a trace context when distributed
+        # tracing is armed, so the agent records the verb as a span;
+        # untraced (sample=0) admin RPCs stay byte-identical
+        tctx = obs_trace.admin_trace()
+        if tctx is not None:
+            headers[obs_trace.TRACE_HEADER] = obs_trace.format_header(
+                tctx.child(obs_trace.new_span_id()))
         req = urllib.request.Request(
             url + path, data=json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"})
+            headers=headers)
         try:
             with urllib.request.urlopen(req,
                                         timeout=self.timeout_s) as r:
@@ -367,7 +381,9 @@ class FleetScheduler:
                     action["result"])
         if self.record is not None:
             self.record.event("fleet_schedule", **{
-                k: action[k] for k in ("action", "source", "reason")})
+                k: action[k]
+                for k in ("action", "source", "reason", "corr")
+                if k in action})
         return action
 
     def rollback(self, reason: str = "operator") -> Dict:
@@ -377,21 +393,24 @@ class FleetScheduler:
         idempotent the same way the controller is, and recorded in
         ``self.actions`` next to add/drain so the tick history tells
         the whole story."""
+        smp = _latest(self.store)
+        corr = correlation_id(smp["ts"]) if smp is not None else None
         if self.rollout is None:
             action = {"action": "rollback", "reason": reason,
-                      "result": None, "error": "NoRolloutController"}
+                      "result": None, "error": "NoRolloutController",
+                      "corr": corr}
             with self._actions_lock:
                 self.actions.append(action)
             return action
         result = self.rollout.rollback(reason)
         action = {"action": "rollback", "reason": reason,
-                  "result": result}
+                  "result": result, "corr": corr}
         with self._actions_lock:
             self.actions.append(action)
         logger.warning("scheduler: rollback (%s) -> %s", reason, result)
         if self.record is not None:
             self.record.event("fleet_schedule", action="rollback",
-                              source="*", reason=reason)
+                              source="*", reason=reason, corr=corr)
         return action
 
     def start(self) -> "FleetScheduler":

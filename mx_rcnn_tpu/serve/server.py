@@ -38,7 +38,7 @@ from typing import List, Optional
 import numpy as np
 
 from mx_rcnn_tpu.netio import (BodyError, check_timeout_ms,
-                               read_request_body)
+                               check_trace_header, read_request_body)
 from mx_rcnn_tpu.obs import trace as obs_trace
 from mx_rcnn_tpu.serve.engine import ServingEngine
 from mx_rcnn_tpu.serve.queue import (DeadlineExceeded, RequestFailed,
@@ -166,6 +166,12 @@ class DetectionHandler(BaseHTTPRequestHandler):
             # a peer-supplied inf/NaN timeout must die HERE as a 400,
             # not later in deadline arithmetic (wirefuzz contract)
             timeout_ms = check_timeout_ms(body.get("timeout_ms"))
+            # inbound distributed trace context: absent → None (the
+            # back-compat path), malformed → 400 (never zero-filled)
+            hdr = check_trace_header(
+                self.headers.get(obs_trace.TRACE_HEADER))
+            tctx = (obs_trace.parse_header(hdr) if hdr is not None
+                    else None)
         except BodyError as e:
             # 411 absent Content-Length / 413 over cap / 400 short body
             self._reply(e.status, {"error": str(e)})
@@ -178,7 +184,7 @@ class DetectionHandler(BaseHTTPRequestHandler):
         try:
             # submit+wait (not engine.detect): the handle carries the
             # batch_rows the response promises
-            req = engine.submit(img, timeout_ms=timeout_ms)
+            req = engine.submit(img, timeout_ms=timeout_ms, tctx=tctx)
             wait_s = None
             if req.deadline is not None:
                 wait_s = max(req.deadline - time.monotonic(), 0.0) + 30.0

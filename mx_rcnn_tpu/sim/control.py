@@ -292,7 +292,8 @@ class SimRun:
         action = self.scheduler.tick()
         if action is not None:
             entry = {k: action.get(k) for k in
-                     ("action", "source", "reason", "ready", "target")}
+                     ("action", "source", "reason", "ready", "target",
+                      "corr")}
             result = action.get("result")
             entry["result"] = (dict(result)
                                if isinstance(result, dict) else result)

@@ -174,7 +174,7 @@ def cmd_dump(args) -> int:
               "obs.collect_urls)", file=sys.stderr)
         return EXIT_NO_SOURCE
     view = collector.collect()
-    record = {"schema": "mx_rcnn_tpu.flight/1", "reason": "manual",
+    record = {"schema": "mx_rcnn_tpu.flight/2", "reason": "manual",
               "ts": view["ts"], "pid": os.getpid(), "view": view}
     from mx_rcnn_tpu.utils.checkpoint import _atomic_write
 
@@ -400,7 +400,7 @@ def run_smoke(args) -> dict:
         else:
             with open(dumps[0]) as f:
                 rec = json.load(f)
-            if rec.get("schema") != "mx_rcnn_tpu.flight/1":
+            if rec.get("schema") != "mx_rcnn_tpu.flight/2":
                 problems.append(f"flight schema wrong: "
                                 f"{rec.get('schema')}")
             if not rec.get("samples"):

@@ -54,20 +54,26 @@ from mx_rcnn_tpu.analysis.wirefuzz import (ACCEPTED_VALID, ALLOC,
                                            http_case_outcome,
                                            http_post_raw, run_case,
                                            summarize)
+from mx_rcnn_tpu.obs import trace as obs_trace
 from mx_rcnn_tpu.serve.remote import (_REQ_HEAD, _RESP_ENTRY,
-                                      _RESP_HEAD, RESULT_MAGIC,
-                                      WIRE_MAGIC, decode_prepared,
-                                      decode_result, encode_prepared,
+                                      _RESP_HEAD, _RESP_TRACE_EXT,
+                                      RESULT_MAGIC, WIRE_MAGIC,
+                                      decode_prepared,
+                                      decode_prepared_ex, decode_result,
+                                      decode_result_ex, encode_prepared,
                                       encode_result)
 
 logger = logging.getLogger("mx_rcnn_tpu")
 
 # MXR1 request header spans: load-bearing fields (a flip must reject)
-# vs data-carrying fields (a flip must merely stay typed/no-crash)
+# vs data-carrying fields (a flip must merely stay typed/no-crash).
+# The former reserved field (12:14) is now FLAGS and load-bearing: any
+# set bit either declares a trace extension that is not present or is
+# an unknown flag — both must typed-reject on an untraced frame.
 REQ_REJECT_SPANS = [("magic", 0, 4), ("version", 4, 6),
-                    ("h", 6, 8), ("w", 8, 10), ("c", 10, 12)]
-REQ_BENIGN_SPANS = [("reserved", 12, 14), ("timeout", 14, 18),
-                    ("im_info", 18, 30)]
+                    ("h", 6, 8), ("w", 8, 10), ("c", 10, 12),
+                    ("flags", 12, 14)]
+REQ_BENIGN_SPANS = [("timeout", 14, 18), ("im_info", 18, 30)]
 # MXD1 result header + first entry: the class id is data, the row
 # COUNT is load-bearing (it sizes the decode)
 RES_REJECT_SPANS = [("magic", 0, 4), ("version", 4, 6), ("n", 6, 8),
@@ -107,6 +113,103 @@ def prepared_corpus(seed: int, shape=(16, 20)) -> List[Mutation]:
                                 REQ_BENIGN_SPANS, extra=extra)
 
 
+def traced_prepared_corpus(seed: int, shape=(16, 20)) -> List[Mutation]:
+    """Trace-extension arms over a ctx-carrying MXR1 frame.  Once the
+    flag bit declares an extension, the extension bytes are
+    LOAD-BEARING: truncations, inflations, version/length lies, and
+    charset violations must typed-reject (never zero-fill or silently
+    degrade to untraced) — only unknown ctx FLAG bits are the pinned
+    forward-compat carve-out (ignored, frame decodes)."""
+    rng = np.random.RandomState(seed)
+    data = (rng.rand(*shape, 3) * 255.0).astype(np.float32)
+    info = np.array([shape[0], shape[1], 1.0], np.float32)
+    ctx = obs_trace.TraceContext("feed.1234abcd", parent=0xDEAD,
+                                 hop=2, sampled=True)
+    frame = encode_prepared(data, info, 500.0, ctx=ctx)
+    ext_off = _REQ_HEAD.size + shape[0] * shape[1] * 3 * 4
+    ext_len = len(frame) - ext_off
+
+    def patched(off: int, val: int) -> bytes:
+        d = bytearray(frame)
+        d[off] = val
+        return bytes(d)
+
+    muts = [
+        Mutation("tr:valid", frame, False),
+        # flag set, extension entirely absent
+        Mutation("tr:trunc@ext", frame[:ext_off], True),
+        # extension cut inside its fixed header
+        Mutation("tr:trunc@ext+3", frame[:ext_off + 3], True),
+        # one byte short of the declared id length
+        Mutation("tr:trunc@-1", frame[:-1], True),
+        # inflated: trailing bytes past the declared id length
+        Mutation("tr:inflate+1", frame + b"\0", True),
+        Mutation("tr:inflate+64", frame + b"\x41" * 64, True),
+        # ctx version lies (byte 0 of the extension)
+        Mutation("tr:ctx-version=0", patched(ext_off, 0), True),
+        Mutation("tr:ctx-version=255", patched(ext_off, 255), True),
+        # unknown ctx FLAG bits: forward-compat, must decode
+        Mutation("tr:ctx-flags=0x81", patched(ext_off + 1, 0x81), False),
+        # id-length lies (byte 12 of the extension): zero, over-cap,
+        # and off-by-one against the actual payload
+        Mutation("tr:idlen=0", patched(ext_off + 12, 0), True),
+        Mutation("tr:idlen=255", patched(ext_off + 12, 255), True),
+        Mutation("tr:idlen+1",
+                 patched(ext_off + 12, ext_len - 13 + 1), True),
+        # id charset violation (first id byte → '!')
+        Mutation("tr:id-charset", patched(ext_off + 13, 0x21), True),
+        Mutation("tr:id-nonascii", patched(ext_off + 13, 0xFF), True),
+    ]
+    # deterministic bit flips across the extension: every arm must
+    # either reject or decode to a well-formed ctx — never crash
+    for i in range(ext_len):
+        off = ext_off + i
+        d = bytearray(frame)
+        d[off] ^= 1 << (i % 8)
+        muts.append(Mutation(f"tr:flip@ext+{i}.{i % 8}",
+                             bytes(d), False))
+    return muts
+
+
+def traced_result_corpus(seed: int) -> List[Mutation]:
+    """Skew-extension arms over a version-2 MXD1 result: the 16-byte
+    (t1, t2) extension must be exactly present, and a send stamp that
+    precedes the receive stamp is a lie the codec rejects."""
+    rng = np.random.RandomState(seed)
+    dets = {1: rng.rand(4, 5).astype(np.float32),
+            3: np.zeros((0, 5), np.float32)}
+    v2 = encode_result(dets, ts_pair=(1_000_000, 1_000_500))
+    v1 = encode_result(dets)
+    muts = [
+        Mutation("trr:valid-v2", v2, False),
+        # t2 == t1 is legal (a zero-latency stub)
+        Mutation("trr:t2==t1", encode_result(dets, ts_pair=(7, 7)),
+                 False),
+        # send stamp precedes receive
+        Mutation("trr:t2<t1",
+                 encode_result(dets, ts_pair=(1_000_500, 1_000_000)),
+                 True),
+        # version 2 with the extension truncated / absent
+        Mutation("trr:ext-trunc", v2[:-1], True),
+        Mutation("trr:ext-absent", v2[:-_RESP_TRACE_EXT.size], True),
+        # version 2 with an inflated extension
+        Mutation("trr:ext-inflate", v2 + b"\0" * 4, True),
+        # version 1 carrying trailing extension bytes it never declared
+        Mutation("trr:v1-trailing-ext",
+                 v1 + v2[-_RESP_TRACE_EXT.size:], True),
+    ]
+    # bit flips inside the stamps: reject (t2<t1) or decode, no crash
+    rnd = np.random.RandomState(seed + 1)
+    for _ in range(8):
+        off = len(v2) - _RESP_TRACE_EXT.size + int(rnd.randint(0, 16))
+        bit = int(rnd.randint(0, 8))
+        d = bytearray(v2)
+        d[off] ^= 1 << bit
+        muts.append(Mutation(f"trr:flip@ext+{off - (len(v2) - 16)}.{bit}",
+                             bytes(d), False))
+    return muts
+
+
 def result_corpus(seed: int) -> List[Mutation]:
     frame = _result_frame()
     inflate = bytearray(frame)
@@ -132,8 +235,13 @@ def leg_codec(seed: int, smoke: bool = False) -> Dict:
         results += fuzz_codec(decode_prepared, muts)
     for j in (7, 9) if not smoke else (7,):
         results += fuzz_codec(decode_result, result_corpus(seed + j))
+    # trace-extension arms (PR-19): the ctx-carrying request frame and
+    # the skew-carrying v2 result, against the _ex decode surfaces
+    results += fuzz_codec(decode_prepared_ex,
+                          traced_prepared_corpus(seed))
+    results += fuzz_codec(decode_result_ex, traced_result_corpus(seed))
     out = summarize(results)
-    out["target"] = "decode_prepared/decode_result"
+    out["target"] = "decode_prepared[_ex]/decode_result[_ex]"
     return out
 
 
@@ -262,6 +370,31 @@ def leg_agent(seed: int, smoke: bool = False) -> Dict:
                    None if ok else repr(first[:40]))
         finally:
             sock.close()
+        # traced frames over the wire: a valid ctx-carrying frame must
+        # serve (200), a mutilated extension must 4xx — and must NOT
+        # silently serve as untraced (the no-zero-fill contract holds
+        # end-to-end, not just in-process)
+        tmuts = [m for m in traced_prepared_corpus(seed, (16, 20))
+                 if m.must_reject]
+        if smoke:
+            tmuts = tmuts[::4]
+        for m in tmuts:
+            res = http_post_raw(host, port, "/prepared", m.data)
+            record(f"http:{m.name}",
+                   http_case_outcome(res, True, deadline_s),
+                   res.get("error"))
+        b = tuple(cfg.bucket.shapes[0])
+        rng = np.random.RandomState(seed + 3)
+        good_traced = encode_prepared(
+            (rng.rand(*b, 3) * 255.0).astype(np.float32),
+            np.array([b[0], b[1], 1.0], np.float32), 10_000.0,
+            ctx=obs_trace.TraceContext("feed.cafe", parent=0xBEEF,
+                                       hop=1, sampled=True))
+        res = http_post_raw(host, port, "/prepared", good_traced,
+                            timeout_s=30.0)
+        record("http:tr:good-traced-frame",
+               ACCEPTED_VALID if res.get("status") == 200 else CRASHED,
+               None if res.get("status") == 200 else str(res))
         # aftermath: the server still answers /healthz and serves a
         # good frame — no fuzz case may have wedged it
         record("aftermath:healthz",

@@ -1,0 +1,487 @@
+"""Distributed-tracing tests (ISSUE 19): wire-propagated trace context
+(compact frame extension + ``X-MXR-Trace`` header codec, back-compat
+bit-identity pinned), tail sampling and forced terminal retention,
+per-terminal-state span audit, NTP-style skew estimation with a
+monotonic skew-corrected merge, decision-log correlation ids, the
+flight-recorder schema /2 trace-tree tail, and the doctor primitives
+(`tools/trace.py`).
+
+Everything here is in-process and stub-driven (quick tier).  The
+multi-PROCESS claims — 100%-complete span trees across real agent
+subprocesses, the SIGKILL-reroute single-trace view, the <2% overhead
+A/B — are ``tools/trace.py --check``'s job (docs/TRACE_r19.json).
+"""
+
+import json
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.obs import trace as obs_trace
+from mx_rcnn_tpu.obs.trace import (TraceContext, decode_ctx, encode_ctx,
+                                   format_header, merge_fleet_trace,
+                                   parse_header, tree_complete,
+                                   tree_monotonic)
+from mx_rcnn_tpu.serve.queue import SERVED, ServeRequest
+from mx_rcnn_tpu.serve.remote import (_REQ_HEAD, WIRE_MAGIC,
+                                      WIRE_VERSION, decode_prepared,
+                                      decode_prepared_ex,
+                                      decode_result_ex, encode_prepared,
+                                      encode_result)
+from mx_rcnn_tpu.tools.trace import (attribution_table, decision_query,
+                                     format_tree, load_traces)
+
+
+@pytest.fixture(autouse=True)
+def _clean_distributed_state():
+    """Every test starts and ends with the distributed plane unarmed —
+    the module mutates process-global sampling/ring/skew state."""
+    obs_trace.reset_distributed()
+    yield
+    obs_trace.reset_distributed()
+
+
+def _cfg(**kw):
+    over = {
+        "bucket__scale": 128, "bucket__max_size": 160,
+        "bucket__shapes": ((128, 160), (160, 128)),
+        "serve__batch_size": 2, "serve__max_delay_ms": 5.0,
+        "fleet__health_interval_s": 30.0,
+    }
+    over.update(kw)
+    return generate_config("tiny", "synthetic", **over)
+
+
+def _frame_parts(seed=0, shape=(16, 20)):
+    rng = np.random.RandomState(seed)
+    data = (rng.rand(*shape, 3) * 255.0).astype(np.float32)
+    info = np.array([shape[0], shape[1], 1.0], np.float32)
+    return data, info
+
+
+# ---------------------------------------------------------------------------
+# context codec: blob + header
+# ---------------------------------------------------------------------------
+
+def test_ctx_blob_round_trip():
+    for ctx in (TraceContext("abc123", parent=0, hop=0, sampled=True),
+                TraceContext("de.ad-be_ef:0", parent=(1 << 64) - 1,
+                             hop=65535, sampled=False),
+                TraceContext("f" * 64, parent=7, hop=3, sampled=True)):
+        assert decode_ctx(encode_ctx(ctx)) == ctx
+
+
+def test_ctx_blob_rejects_malformed():
+    blob = encode_ctx(TraceContext("abc123", parent=5, hop=1))
+    cases = [
+        blob[:3],                      # truncated header
+        blob[:-1],                     # short of declared id length
+        blob + b"0",                   # trailing bytes past declared
+        b"\x02" + blob[1:],            # unknown version
+        blob[:12] + b"\x00" + blob[13:],   # idlen 0
+        blob[:12] + b"\xff" + blob[13:],   # idlen over cap
+        blob[:13] + b"!" + blob[14:],  # charset violation
+        blob[:13] + b"\xff" + blob[14:],   # non-ascii id byte
+    ]
+    for buf in cases:
+        with pytest.raises(ValueError):
+            decode_ctx(buf)
+    # unknown FLAG bits are the pinned forward-compat carve-out
+    fwd = blob[:1] + bytes([blob[1] | 0x80]) + blob[2:]
+    assert decode_ctx(fwd).trace_id == "abc123"
+
+
+def test_ctx_header_round_trip_and_malformed():
+    ctx = TraceContext("abc.123", parent=0xBEEF, hop=2, sampled=False)
+    assert parse_header(format_header(ctx)) == ctx
+    for bad in ("", "v2;id=a;parent=0;hop=0;s=1",
+                "v1;id=a;parent=0;hop=0",          # missing s
+                "v1;id=a;parent=zz;hop=0;s=1",     # bad hex
+                "v1;id=a;parent=0;hop=0;s=2",      # bad sampling bit
+                "v1;id=a;parent=0;hop=99999;s=1",  # hop out of range
+                "v1;id=nope!;parent=0;hop=0;s=1",  # charset
+                "v1;garbage",                      # field w/o '='
+                "v1;id=" + "a" * 400):             # oversized
+        with pytest.raises(ValueError):
+            parse_header(bad)
+
+
+# ---------------------------------------------------------------------------
+# frame extension: back-compat bit-identity + typed rejection
+# ---------------------------------------------------------------------------
+
+def test_untraced_frame_bit_identical_to_pr15_layout():
+    """The back-compat pin: ``ctx=None`` produces EXACTLY the pre-trace
+    bytes — header flags 0, nothing appended — reconstructed here from
+    the frozen struct layout, not from the encoder under test."""
+    data, info = _frame_parts(seed=3)
+    buf = encode_prepared(data, info, 250.0, ctx=None)
+    h, w, c = data.shape
+    golden = _REQ_HEAD.pack(WIRE_MAGIC, WIRE_VERSION, h, w, c, 0,
+                            250.0, *[float(v) for v in info]
+                            ) + data.tobytes()
+    assert buf == golden
+
+
+def test_traced_frame_round_trip_and_untraced_decode():
+    data, info = _frame_parts(seed=4)
+    ctx = TraceContext("abc.def", parent=0x1234, hop=1, sampled=True)
+    buf = encode_prepared(data, info, 500.0, ctx=ctx)
+    out, oinfo, t, octx = decode_prepared_ex(buf)
+    assert out.tobytes() == data.tobytes()
+    assert octx == ctx
+    # flag-less frames decode with ctx None through the same surface
+    plain = encode_prepared(data, info, 500.0)
+    assert decode_prepared_ex(plain)[3] is None
+    # the PR-15 decode surface still accepts BOTH layouts
+    assert decode_prepared(buf)[0].tobytes() == data.tobytes()
+
+
+def test_frame_trace_flag_malformations_reject():
+    data, info = _frame_parts(seed=5)
+    ctx = TraceContext("abc", parent=1, hop=0)
+    traced = encode_prepared(data, info, 0.0, ctx=ctx)
+    plain = encode_prepared(data, info, 0.0)
+    ext = traced[len(plain):]
+
+    def with_flags(buf, flags):
+        d = bytearray(buf)
+        struct.pack_into("<H", d, 12, flags)
+        return bytes(d)
+
+    with pytest.raises(ValueError):       # unknown flag bit
+        decode_prepared_ex(with_flags(plain, 0x2))
+    with pytest.raises(ValueError):       # flag set, extension absent
+        decode_prepared_ex(with_flags(plain, 0x1))
+    with pytest.raises(ValueError):       # extension without the flag
+        decode_prepared_ex(plain + ext)
+    with pytest.raises(ValueError):       # truncated extension
+        decode_prepared_ex(traced[:-1])
+    with pytest.raises(ValueError):       # inflated extension
+        decode_prepared_ex(traced + b"\0")
+
+
+def test_result_skew_extension_round_trip_and_malformed():
+    rng = np.random.RandomState(0)
+    dets = {1: rng.rand(3, 5).astype(np.float32)}
+    v1 = encode_result(dets)
+    v2 = encode_result(dets, ts_pair=(1_000_000, 1_000_750))
+    out, ts = decode_result_ex(v2)
+    assert ts == (1_000_000.0, 1_000_750.0)
+    assert out[1].tobytes() == dets[1].tobytes()
+    assert decode_result_ex(v1)[1] is None
+    assert v2[:len(v1)] != v1            # version byte differs
+    with pytest.raises(ValueError):      # send precedes receive
+        decode_result_ex(encode_result(dets, ts_pair=(200, 100)))
+    with pytest.raises(ValueError):      # truncated extension
+        decode_result_ex(v2[:-1])
+    with pytest.raises(ValueError):      # v1 with trailing ext bytes
+        decode_result_ex(v1 + v2[-16:])
+
+
+# ---------------------------------------------------------------------------
+# sampling + retention policy
+# ---------------------------------------------------------------------------
+
+def test_sample_trace_is_deterministic_fraction():
+    obs_trace.configure_distributed(sample=0.25, ring=64, host="head")
+    picks = [obs_trace.sample_trace() is not None for _ in range(100)]
+    assert sum(picks) == 25
+    # exactly 1-in-4, not a coin flip: every 4th admission is sampled
+    assert picks[3::4] == [True] * 25
+    obs_trace.configure_distributed(sample=0.0)
+    assert obs_trace.sample_trace() is None
+
+
+def test_retain_trace_forces_terminals_and_keeps_tail():
+    obs_trace.configure_distributed(sample=1.0, ring=64, slow_pct=90.0)
+    # every non-SERVED terminal and every rerouted request is forced
+    for state in ("EXPIRED", "FAILED", "SHED"):
+        assert obs_trace.retain_trace(state, total_ms=1.0)
+    assert obs_trace.retain_trace("SERVED", total_ms=1.0, attempts=2)
+    # warmup keeps everything until the window has 32 samples
+    assert obs_trace.retain_trace("SERVED", total_ms=1.0)
+    for _ in range(50):
+        obs_trace.retain_trace("SERVED", total_ms=10.0)
+    # now a fast request drops, a slow one stays
+    assert not obs_trace.retain_trace("SERVED", total_ms=1.0)
+    assert obs_trace.retain_trace("SERVED", total_ms=500.0)
+
+
+def test_admin_trace_gated_on_armed_plane():
+    assert obs_trace.admin_trace() is None       # unarmed
+    obs_trace.configure_distributed(sample=0.01, ring=16)
+    ctx = obs_trace.admin_trace()                # armed: ALWAYS sampled
+    assert ctx is not None and ctx.sampled and ctx.hop == 0
+
+
+def test_correlation_id_deterministic():
+    assert obs_trace.correlation_id(12.345) == obs_trace.correlation_id(
+        12.345)
+    assert obs_trace.correlation_id(1.0) == "w3e8"
+
+
+# ---------------------------------------------------------------------------
+# terminal-span audit (queue level) + ring close semantics
+# ---------------------------------------------------------------------------
+
+def test_every_terminal_transition_records_exactly_one_terminal_span():
+    obs_trace.configure_distributed(sample=1.0, ring=64, slow_pct=0.0)
+    for state in (SERVED, "shed", "expired", "failed"):
+        ctx = TraceContext(f"t.{state}", parent=obs_trace.new_span_id(),
+                           hop=0)
+        req = ServeRequest(np.zeros((2, 2, 3), np.float32),
+                           np.array([2, 2, 1], np.float32), (2, 2),
+                           None, time.monotonic())
+        req.tctx = ctx
+        assert req._finish(state)
+        assert not req._finish(state)        # exactly-once: no 2nd span
+        obs_trace.close_trace(ctx, keep=True, state=state)
+        tree = obs_trace.kept_trees()[-1]
+        names = [s["name"] for s in tree["spans"]]
+        assert names.count(f"terminal.{state}") == 1
+
+
+def test_span_ring_close_drops_or_keeps_and_bounds():
+    ring = obs_trace.SpanRing(cap=2, cap_spans=4)
+    for tid, keep in (("a", True), ("b", False), ("c", True),
+                      ("d", True)):
+        ring.record(tid, {"name": "x", "span": 1, "parent": 0,
+                          "ts": 0.0, "dur": 1.0})
+        ring.close(tid, keep=keep)
+    trees = ring.trees()
+    assert [t["trace"] for t in trees] == ["c", "d"]  # cap 2, b dropped
+    ring.close("never-opened", keep=True)             # no-op, no raise
+    assert ring.open_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# skew estimation + corrected merge
+# ---------------------------------------------------------------------------
+
+def test_skew_estimator_recovers_known_offset():
+    est = obs_trace.SkewEstimator(window=64)
+    # agent clock runs 5 ms AHEAD; symmetric 1 ms one-way delay
+    for k in range(20):
+        t0 = k * 10_000
+        t1 = t0 + 1_000 + 5_000
+        t2 = t1 + 2_000
+        t3 = t0 + 4_000
+        est.note("remote-0", t0, t1, t2, t3)
+    # queueing-noise samples with inflated rtt must not move the median
+    for k in range(10):
+        t0 = 1_000_000 + k * 10_000
+        est.note("remote-0", t0, t0 + 90_000, t0 + 91_000, t0 + 100_000)
+    assert est.offset_ms("remote-0") == pytest.approx(5.0, abs=0.01)
+    g = est.gauges()
+    assert g["obs.skew_ms.remote-0"] == pytest.approx(5.0, abs=0.01)
+    assert g["obs.skew_ms.max"] == pytest.approx(5.0, abs=0.01)
+    assert est.offset_ms("never-seen") is None
+
+
+def test_merge_corrects_remote_clocks_and_stays_monotonic(tmp_path):
+    # head tree: root at t=10ms, wire child at 11ms (head clock, µs)
+    root, wire, aroot = 0x10, 0x11, 0x12
+    local = [{"trace": "m.1", "host": "head", "spans": [
+        {"name": "request", "span": root, "parent": 0, "ts": 10_000.0,
+         "dur": 8_000.0, "host": "head", "hop": 0},
+        {"name": "remote.wire", "span": wire, "parent": root,
+         "ts": 11_000.0, "dur": 6_000.0, "host": "head", "hop": 1}]}]
+    # agent clock runs 30 ms ahead: uncorrected, its span would start
+    # BEFORE the head root in head time order after correction test
+    remote = {"remote-0": [{"trace": "m.1", "host": "agent", "spans": [
+        {"name": "agent.request", "span": aroot, "parent": wire,
+         "ts": 12_000.0 + 30_000.0, "dur": 4_000.0, "host": "agent",
+         "hop": 2}]}]}
+    out = tmp_path / "merged.json"
+    doc = merge_fleet_trace(local, remote, {"remote-0": 30.0},
+                            path=str(out))
+    spans = doc["traces"]["m.1"]
+    assert tree_complete(spans) and tree_monotonic(spans)
+    byid = {s["span"]: s for s in spans}
+    assert byid[aroot]["ts"] == pytest.approx(12_000.0)  # corrected
+    assert doc["metadata"]["offsets_ms"] == {"remote-0": 30.0}
+    # an OVER-estimated offset inverts the edge; the clamp repairs it
+    # and counts the repair honestly
+    doc2 = merge_fleet_trace(local, remote, {"remote-0": 35.0})
+    spans2 = doc2["traces"]["m.1"]
+    assert tree_monotonic(spans2)
+    assert doc2["metadata"]["clamped"] >= 1
+    # the chrome file round-trips through the doctor's loader with the
+    # same span/parent structure
+    loaded = load_traces(str(out))
+    assert {s["span"] for s in loaded["m.1"]} == {root, wire, aroot}
+    assert tree_complete(loaded["m.1"])
+
+
+# ---------------------------------------------------------------------------
+# in-process two-hop completeness (head fleet -> agent server)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.usefixtures("_clean_distributed_state")
+def test_two_hop_span_tree_is_complete_in_process():
+    from mx_rcnn_tpu.serve.agent import ReplicaAgent, make_agent_server
+    from mx_rcnn_tpu.serve.remote import build_crosshost_router
+    from mx_rcnn_tpu.tools.loadgen import make_content_stub_run_fn
+
+    cfg = _cfg(crosshost__connections=1, crosshost__pipeline_depth=4,
+               crosshost__scrape_interval_s=30.0,
+               obs__trace_sample=1.0, obs__trace_slow_pct=0.0)
+    ag = ReplicaAgent(cfg, None, {}, run_fn_factory=(
+        lambda rid: make_content_stub_run_fn(cfg)))
+    srv = make_agent_server(ag, "127.0.0.1", 0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    obs_trace.configure_distributed(sample=1.0, ring=256, slow_pct=0.0,
+                                    host="head")
+    router = feed = None
+    try:
+        router, feed = build_crosshost_router(cfg, [url])
+        b = tuple(cfg.bucket.shapes[0])
+        rng = np.random.RandomState(7)
+        reqs = [router.submit_prepared(
+            (rng.rand(*b, 3) * 255.0).astype(np.float32),
+            np.array([b[0], b[1], 1.0], np.float32), b,
+            timeout_ms=30_000) for _ in range(4)]
+        for r in reqs:
+            assert r.wait(timeout=30.0) is not None
+        # wait() unblocks inside the terminal transition, BEFORE the
+        # worker thread records the root span and closes the trace —
+        # poll until every trace settled (a "request" span landed)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            trees = obs_trace.kept_trees()
+            settled = sum(
+                1 for t in trees
+                if any(s["name"] == "request" and s["hop"] == 0
+                       for s in t["spans"]))
+            if settled >= 4:
+                break
+            time.sleep(0.02)
+        # head and (in-process) agent share the ring: fold every kept
+        # tree by trace id, exactly what merge_fleet_trace does
+        doc = merge_fleet_trace(obs_trace.kept_trees(), {}, {})
+        assert len(doc["traces"]) >= 4
+        for tid, spans in doc["traces"].items():
+            names = {s["name"] for s in spans}
+            assert tree_complete(spans), f"incomplete tree {tid}"
+            assert {"request", "remote.wire", "agent.request",
+                    "serve.compute", "terminal.served"} <= names
+    finally:
+        if feed is not None:
+            feed.close()
+        if router is not None:
+            router.close()
+        srv.shutdown()
+        srv.server_close()
+        ag.close()
+
+
+def test_untraced_hot_path_stays_cold():
+    """``obs.trace_sample = 0`` (the default) must leave serving
+    untouched: no contexts minted, no trees kept, wire bytes
+    bit-identical (the encoder pin above) — the hot path pays exactly
+    the ``ctx is None`` checks."""
+    assert obs_trace.sample_trace() is None
+    assert obs_trace.admin_trace() is None
+    assert obs_trace.kept_trees() == []
+    obs_trace.configure_distributed(sample=0.0, ring=64)
+    assert obs_trace.sample_trace() is None     # armed ring, zero rate
+
+
+# ---------------------------------------------------------------------------
+# flight recorder schema /2
+# ---------------------------------------------------------------------------
+
+def test_flight_dump_carries_trace_tree_tail(tmp_path):
+    from mx_rcnn_tpu.obs.flightrec import FlightRecorder
+    from mx_rcnn_tpu.obs.timeseries import TimeSeriesStore
+
+    obs_trace.configure_distributed(sample=1.0, ring=16, slow_pct=0.0,
+                                    host="head")
+    ctx = TraceContext("fl.1", parent=obs_trace.new_span_id(), hop=0)
+    obs_trace.record_span(ctx, "request", 5.0, span_id=ctx.parent,
+                          parent=0)
+    obs_trace.close_trace(ctx, keep=True, state="served")
+    rec = FlightRecorder(TimeSeriesStore(capacity=8),
+                         str(tmp_path / "run"), trace_tree_tail=8)
+    path = rec.dump("test", force=True)
+    with open(path) as f:
+        record = json.load(f)
+    assert record["schema"] == "mx_rcnn_tpu.flight/2"
+    assert [t["trace"] for t in record["trace_trees"]] == ["fl.1"]
+
+
+# ---------------------------------------------------------------------------
+# doctor primitives
+# ---------------------------------------------------------------------------
+
+def test_format_tree_nests_children_and_marks_orphans():
+    spans = [
+        {"name": "request", "span": 1, "parent": 0, "ts": 0.0,
+         "dur": 9_000.0, "host": "head", "hop": 0},
+        {"name": "fleet.attempt", "span": 2, "parent": 1, "ts": 100.0,
+         "dur": 8_000.0, "host": "head", "hop": 0},
+        {"name": "agent.request", "span": 3, "parent": 99,  # lost hop
+         "ts": 200.0, "dur": 7_000.0, "host": "agent", "hop": 2},
+    ]
+    lines = format_tree(spans)
+    assert lines[0].startswith("request")
+    assert lines[1].startswith("  fleet.attempt")   # nested one level
+    assert any("(orphan)" in ln and "agent.request" in ln
+               for ln in lines)
+
+
+def test_attribution_table_percentiles():
+    traces = {"t1": [{"name": "serve.compute", "span": 1, "parent": 0,
+                      "ts": 0.0, "dur": d * 1e3}
+                     for d in (1.0, 2.0, 3.0, 100.0)]}
+    tab = attribution_table(traces)
+    assert tab["serve.compute"]["n"] == 4
+    assert tab["serve.compute"]["p50_ms"] == pytest.approx(3.0)
+    assert tab["serve.compute"]["p99_ms"] == pytest.approx(100.0)
+
+
+def test_decision_query_walks_nested_docs():
+    doc = {"actions": [{"action": "add", "corr": "w1a"},
+                       {"action": "rollback", "corr": "w2b",
+                        "nested": [{"corr": "w1a", "kind": "inner"}]}],
+           "events": {"deep": [{"corr": "w1a"}]}}
+    hits = decision_query(doc, "w1a")
+    assert len(hits) == 3
+    assert all(h["corr"] == "w1a" for h in hits)
+    assert decision_query(doc, "w9z") == []
+
+
+def test_scheduler_actions_carry_correlation_ids():
+    """Every scheduler decision (and rollback) carries the triggering
+    sample's ``w<epoch-ms hex>`` correlation id — the join key
+    ``tools/trace.py --decision`` queries on."""
+    from mx_rcnn_tpu.obs.timeseries import TimeSeriesStore
+    from mx_rcnn_tpu.serve.scheduler import SchedulerPolicy
+
+    cfg = _cfg(crosshost__for_samples=2, crosshost__idle_samples=3,
+               crosshost__cooldown_s=5.0, crosshost__window_s=3.0,
+               crosshost__min_replicas=1, crosshost__max_replicas=8,
+               crosshost__up_shed_ratio=0.05, crosshost__up_backlog=2.0)
+    store = TimeSeriesStore(capacity=64)
+    pol = SchedulerPolicy(cfg)
+
+    def snap(ts, ready):
+        store.append_snapshot(
+            {"counters": {}, "gauges": {
+                f"agent.replicas_ready@{src}": v
+                for src, v in ready.items()}}, ts=ts)
+
+    snap(0.0, {"agent-0": 1, "agent-1": 1})
+    assert pol.decide(store, now=0.0) is None   # target adopted: 2
+    snap(1.0, {"agent-0": 1})                   # host death → deficit
+    assert pol.decide(store, now=1.0) is None   # hysteresis
+    snap(2.0, {"agent-0": 1})
+    act = pol.decide(store, now=2.0)
+    assert act and act["action"] == "add"
+    assert act["corr"] == obs_trace.correlation_id(2.0)

@@ -524,7 +524,7 @@ class TestFlightRecorder:
         assert path and os.path.exists(path)
         with open(path) as f:
             doc = json.load(f)
-        assert doc["schema"] == "mx_rcnn_tpu.flight/1"
+        assert doc["schema"] == "mx_rcnn_tpu.flight/2"
         assert doc["reason"] == "manual"
         assert doc["pid"] == os.getpid()
         assert len(doc["samples"]) == 2
