@@ -17,7 +17,7 @@ NATIVE_SRC := $(NATIVE_DIR)/src/nms.cc $(NATIVE_DIR)/src/maskapi.cc
 	obs-smoke perf-smoke elastic-smoke data-smoke fleet-smoke \
 	quant-smoke threadlint-smoke bulk-smoke crashsim-smoke \
 	health-smoke crosshost-smoke wirefuzz-smoke sim-smoke \
-	rollout-smoke trace-smoke clean
+	rollout-smoke trace-smoke wire-smoke clean
 
 all: native
 
@@ -212,6 +212,17 @@ threadlint-smoke:
 wirefuzz-smoke:
 	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.wirefuzz --smoke
 
+# wire data-plane smoke (docs/SERVING.md "Wire format v2"): the
+# WIRE_r20 bench against a real agent subprocess — a shortened
+# v1-fp32 vs v2-u8(+coalesce, +adaptive pipelining) A/B (detections
+# bit-equal across every arm, v2 bytes/image under the ratio bar,
+# coalesced+vectored throughput over the speedup bar, 0 lost, 0
+# post-warm recompiles) plus a SIGKILL-mid-envelope leg where every
+# coalesced frame must terminate exactly once on the survivor.  ~1 min.
+wire-smoke:
+	env JAX_PLATFORMS=cpu python -m mx_rcnn_tpu.tools.loadgen \
+		--wire_smoke --check
+
 # distributed-tracing smoke (docs/OBSERVABILITY.md "Distributed
 # tracing"): the TRACE_r19 protocol against 2 stub-agent subprocesses —
 # a fully-sampled traced burst (every head-kept span tree must be 100%
@@ -272,8 +283,10 @@ elastic-smoke:
 # elastic shrink/grow storm (elastic-smoke, ~3 min), the
 # sanitizer-armed serve+elastic re-run (threadlint-smoke, ~4 min) and
 # the wire-protocol fuzz of the cross-host plane (wirefuzz-smoke,
-# ~1 min) and the distributed-tracing protocol (trace-smoke, ~1 min)
+# ~1 min), the distributed-tracing protocol (trace-smoke, ~1 min) and
+# the v2 wire data-plane A/B (wire-smoke, ~1 min)
 test-gate: lint crashsim-smoke wirefuzz-smoke trace-smoke sim-smoke \
+		wire-smoke \
 		serve-smoke perf-smoke obs-smoke health-smoke data-smoke \
 		fleet-smoke crosshost-smoke bulk-smoke quant-smoke ft-smoke \
 		elastic-smoke rollout-smoke threadlint-smoke

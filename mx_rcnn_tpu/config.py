@@ -412,6 +412,20 @@ class CrosshostConfig:
     # the frame that would exceed connections x pipeline_depth sheds at
     # the head instead of queueing unboundedly toward a slow host)
     pipeline_depth: int = 4
+    # frames coalesced into one count-prefixed MXE1 envelope per send
+    # (1 = every frame ships alone, the PR-15 behavior).  A wire worker
+    # that finds several binary frames queued packs up to this many
+    # into one vectored sendmsg / one HTTP round trip / one agent
+    # wakeup — the burst-rate header+syscall amortization
+    # tools/loadgen.py --wire_bench measures (serve/remote.py)
+    frames_per_send: int = 1
+    # adaptive per-connection pipelining: 0 keeps the fixed
+    # pipeline_depth above; >= 1 lets each RemoteEngine self-tune its
+    # depth in [1, pipeline_depth_max] by AIMD over windowed wire-RTT
+    # samples (serve/remote.py PipelineController) — a slow or skewed
+    # agent stops accumulating in-flight frames instead of inflating
+    # fleet p99
+    pipeline_depth_max: int = 0
     # socket-level I/O timeout for agent RPCs — a transport backstop
     # strictly above any request deadline (deadlines are enforced by the
     # agent's own admission path; this catches dead-host half-opens)

@@ -140,6 +140,31 @@ def load_resized_uint8(
     return np.ascontiguousarray(img), im_scale
 
 
+def pad_normalize(img: np.ndarray, pixel_means: Sequence[float],
+                  bucket: Tuple[int, int]) -> np.ndarray:
+    """Unpadded (h, w, 3) uint8 RGB → padded (bh, bw, 3) fp32 mean-
+    subtracted bucket canvas.  THE single pad+normalize step: both
+    preprocessing tails (:func:`load_and_transform`,
+    :func:`resize_to_bucket`) and the v2 wire's agent-side admission
+    (``serve/remote.py`` u8 source frames) call this one function, so a
+    canvas built on the head and a canvas built by an agent from the
+    same u8 pixels are BIT-identical by construction — not by two code
+    paths happening to agree (pinned by tests/test_wire_v2.py)."""
+    img = np.asarray(img)
+    h, w = img.shape[:2]
+    bh, bw = bucket
+    if h > bh or w > bw:
+        raise ValueError(f"image ({h}, {w}) does not fit bucket "
+                         f"({bh}, {bw})")
+    out = np.zeros((bh, bw, 3), dtype=np.float32)
+    # the fp32 cast fuses with the mean subtraction into the padded output
+    # buffer (device-side normalization via ops/normalize.py computes the
+    # identical float32 values)
+    np.subtract(img, np.asarray(pixel_means, dtype=np.float32),
+                out=out[:h, :w], casting="unsafe")
+    return out
+
+
 def load_and_transform(
     path: str,
     flipped: bool,
@@ -151,15 +176,7 @@ def load_and_transform(
     """Full per-image host pipeline: read → flip → resize → mean-subtract →
     pad into the bucket.  Returns ((bh, bw, 3) fp32 image, im_scale)."""
     img, im_scale = load_resized_uint8(path, flipped, scale, max_size, bucket)
-    h, w = img.shape[:2]
-    bh, bw = bucket
-    out = np.zeros((bh, bw, 3), dtype=np.float32)
-    # the fp32 cast fuses with the mean subtraction into the padded output
-    # buffer (device-side normalization via ops/normalize.py computes the
-    # identical float32 values)
-    np.subtract(img, np.asarray(pixel_means, dtype=np.float32),
-                out=out[:h, :w], casting="unsafe")
-    return out, im_scale
+    return pad_normalize(img, pixel_means, bucket), im_scale
 
 
 def resize_to_bucket(img: np.ndarray, pixel_means: Sequence[float], scale: int,
@@ -183,8 +200,4 @@ def resize_to_bucket(img: np.ndarray, pixel_means: Sequence[float], scale: int,
                 .resize((new_w, new_h)))
         im_scale *= fit
         h, w = resized.shape[:2]
-    bh, bw = bucket
-    out = np.zeros((bh, bw, 3), dtype=np.float32)
-    np.subtract(resized, np.asarray(pixel_means, dtype=np.float32),
-                out=out[:h, :w], casting="unsafe")
-    return out, im_scale, bucket
+    return pad_normalize(resized, pixel_means, bucket), im_scale, bucket

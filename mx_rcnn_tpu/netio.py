@@ -32,6 +32,7 @@ Deliberately dependency-free (stdlib only, no package imports) so both
 from __future__ import annotations
 
 import time
+from typing import Dict, Tuple
 
 # default cap for control-plane JSON replies (healthz, resize results,
 # metric snapshots): far above any legitimate body, far below harm
@@ -142,6 +143,129 @@ def check_trace_header(value, what: str = "X-MXR-Trace"):
     except UnicodeEncodeError:
         raise BodyError(400, f"{what} header is not ascii")
     return value
+
+
+# iovec count per sendmsg call: safely under every platform's IOV_MAX
+# (Linux 1024); the loop below re-issues for anything beyond it
+_IOV_CHUNK = 64
+
+# response-header byte bound for the raw-socket client: a status line +
+# the stdlib server's handful of headers is < 1 KB; anything near this
+# cap is not an HTTP response from our agent
+MAX_HTTP_HEAD = 16 << 10
+
+
+def sendmsg_all(sock, bufs) -> int:
+    """Vectored ``sendall``: ship a list of buffers (bytes / memoryview /
+    anything buffer-protocol) through ``socket.sendmsg`` until every
+    byte is out.  The zero-copy half of the wire hot path
+    (``serve/remote.py``): a frame goes out as header-bytes +
+    memoryview-of-pixels iovecs, so the payload is never concatenated
+    into one transient request body.  Handles short writes by re-slicing
+    the partially-sent buffer (``sendmsg`` has no ``sendall`` twin) and
+    chunks the iovec list under IOV_MAX.  Returns total bytes sent."""
+    views = [memoryview(b).cast("B") for b in bufs]
+    views = [v for v in views if len(v)]
+    total = 0
+    while views:
+        n = sock.sendmsg(views[:_IOV_CHUNK])
+        total += n
+        while n > 0:
+            if n >= len(views[0]):
+                n -= len(views[0])
+                views.pop(0)
+            else:
+                views[0] = views[0][n:]
+                n = 0
+    return total
+
+
+def _parse_http_head(head: bytes, what: str) -> Tuple[int, Dict[str, str]]:
+    lines = head.split(b"\r\n")
+    parts = lines[0].split(None, 2)
+    if len(parts) < 2 or not parts[0].startswith(b"HTTP/"):
+        raise ValueError(f"{what}: not an HTTP status line: "
+                         f"{bytes(lines[0][:80])!r}")
+    try:
+        status = int(parts[1])
+    except ValueError:
+        raise ValueError(f"{what}: unparseable status {parts[1]!r}")
+    headers: Dict[str, str] = {}
+    for ln in lines[1:]:
+        k, _, v = ln.partition(b":")
+        headers[k.strip().lower().decode("latin-1")] = \
+            v.strip().decode("latin-1")
+    return status, headers
+
+
+def read_http_response_into(sock, body: bytearray, max_bytes: int,
+                            deadline_s: float = None,
+                            what: str = "response"
+                            ) -> Tuple[int, int, bool]:
+    """Read ONE HTTP/1.1 response off a blocking socket into a caller-
+    owned (preallocated, reused) ``body`` buffer — the recv half of the
+    wire hot path: no per-response allocation once the buffer has grown
+    to the burst's largest reply.
+
+    Bounded by construction: the header read is capped at
+    :data:`MAX_HTTP_HEAD`, the body must declare Content-Length (our
+    agents always do) and its claim is refused ABOVE ``max_bytes``
+    before a body byte lands, and ``deadline_s`` wall-clock bounds the
+    total read (socket timeouts only bound the gap between bytes).
+    Returns ``(status, body_len, server_wants_close)``; the caller reads
+    the body from ``memoryview(body)[:body_len]`` and must consume it
+    before the next call.  Protocol violations raise ``ValueError``
+    (typed rejection); a peer that vanished raises ``ConnectionError``
+    (the stale-keep-alive retry signal)."""
+    t0 = time.monotonic() if deadline_s else 0.0
+    head = bytearray()
+    while True:
+        idx = head.find(b"\r\n\r\n")
+        if idx >= 0:
+            break
+        if len(head) > MAX_HTTP_HEAD:
+            raise ResponseTooLarge(
+                f"{what}: header exceeded the {MAX_HTTP_HEAD}-byte cap")
+        if deadline_s and time.monotonic() - t0 > deadline_s:
+            raise ResponseTooSlow(
+                f"{what}: header read exceeded {deadline_s:g}s")
+        chunk = sock.recv(8192)
+        if not chunk:
+            raise ConnectionError(
+                f"{what}: peer closed at {len(head)} header bytes")
+        head += chunk
+    status, headers = _parse_http_head(bytes(head[:idx]), what)
+    leftover = head[idx + 4:]
+    claimed = headers.get("content-length")
+    if claimed is None:
+        raise ValueError(f"{what}: missing Content-Length")
+    n = int(claimed)  # ValueError on garbage is the typed rejection
+    if n < 0:
+        raise ValueError(f"{what}: negative Content-Length {n}")
+    if n > int(max_bytes):
+        raise ResponseTooLarge(
+            f"{what}: body of {n} bytes over the {int(max_bytes)}-byte "
+            f"cap")
+    if len(leftover) > n:
+        raise ValueError(f"{what}: {len(leftover) - n} bytes past the "
+                         f"declared body")
+    if len(body) < n:
+        body.extend(bytes(n - len(body)))
+    view = memoryview(body)
+    view[:len(leftover)] = leftover
+    got = len(leftover)
+    while got < n:
+        if deadline_s and time.monotonic() - t0 > deadline_s:
+            raise ResponseTooSlow(
+                f"{what}: body read exceeded {deadline_s:g}s at {got} "
+                f"of {n} bytes")
+        k = sock.recv_into(view[got:n])
+        if not k:
+            raise ConnectionError(
+                f"{what}: peer closed at {got} of {n} body bytes")
+        got += k
+    wants_close = headers.get("connection", "").lower() == "close"
+    return status, n, wants_close
 
 
 def read_request_body(handler, max_bytes: int,

@@ -54,7 +54,11 @@ from mx_rcnn_tpu.obs.metrics import LoweringCounter, Registry
 from mx_rcnn_tpu.serve.export import MANIFEST_NAME
 from mx_rcnn_tpu.serve.queue import (DeadlineExceeded, RequestFailed,
                                      ShedError)
-from mx_rcnn_tpu.serve.remote import (decode_prepared_ex, encode_result,
+from mx_rcnn_tpu.serve.remote import (DTYPE_U8, ENV_EXPIRED, ENV_FAILED,
+                                      ENV_SERVED, ENV_SHED, WireFrame,
+                                      decode_envelope, decode_frame_ex,
+                                      encode_result,
+                                      encode_result_envelope,
                                       normalize_agent_url)
 
 logger = logging.getLogger("mx_rcnn_tpu")
@@ -698,6 +702,81 @@ class _AgentHandler(BaseHTTPRequestHandler):
             self._reply_json(200, {"detections": detections_to_json(
                 dets, self.server.agent.class_names)})
 
+    @staticmethod
+    def _submit_wire_frame(agent, frame: WireFrame, actx):
+        """One decoded request frame → a router admission.  v2 u8
+        source frames go through ``submit_source`` — the engine runs
+        the SAME ``data/image.py pad_normalize`` the head's preprocess
+        tail ends with before enqueue, so the canvas is bit-equal to a
+        head-built one; fp32 frames admit as prepared rows unchanged.
+        A well-formed frame the local router cannot take (unconfigured
+        bucket) raises ValueError → 400 / per-frame FAILED."""
+        if frame.dtype == DTYPE_U8:
+            return agent.router.submit_source(
+                frame.data, frame.im_info, frame.bucket,
+                timeout_ms=frame.timeout_ms, tctx=actx)
+        return agent.router.submit_prepared(
+            frame.data, frame.im_info, frame.bucket,
+            timeout_ms=frame.timeout_ms, tctx=actx)
+
+    def _serve_envelope(self, agent, frames, decode_ms: float,
+                        nbytes: int, t_recv_us: int) -> None:
+        """Admit EVERY frame of a coalesced envelope up front (they
+        progress concurrently through the local router), wait each to
+        its terminal, reply ONE result envelope with a per-frame
+        status.  Each frame keeps its own terminal semantics, its own
+        trace tree and its own skew stamps — the envelope amortizes
+        transport, never accounting."""
+        budget = 60.0
+        subs = []   # (req | None, err, ctx, actx, root_sid) per frame
+        for frame in frames:
+            ctx = frame.ctx
+            actx = None
+            root_sid = 0
+            if ctx is not None:
+                root_sid = obs_trace.new_span_id()
+                actx = ctx.child(root_sid)
+                obs_trace.record_span(actx, "agent.decode", decode_ms,
+                                      bytes=nbytes,
+                                      frames=len(frames))
+            if frame.timeout_ms:
+                budget = max(budget, frame.timeout_ms / 1000.0 + 10.0)
+            try:
+                req = self._submit_wire_frame(agent, frame, actx)
+                subs.append((req, None, ctx, actx, root_sid))
+            except (ValueError, KeyError, TypeError) as e:
+                # an unserveable-but-well-formed frame (unconfigured
+                # bucket) fails ALONE — its envelope mates still serve
+                subs.append((None, str(e), ctx, actx, root_sid))
+        entries = []
+        for req, err, ctx, actx, root_sid in subs:
+            if req is None:
+                status, payload, outcome = (ENV_FAILED, err.encode(),
+                                            "rejected")
+            else:
+                try:
+                    dets = req.wait(timeout=budget)
+                except ShedError:
+                    status, payload, outcome = ENV_SHED, b"", "ShedError"
+                except DeadlineExceeded:
+                    status, payload, outcome = (ENV_EXPIRED, b"",
+                                                "DeadlineExceeded")
+                except (RequestFailed, TimeoutError) as e:
+                    status, payload, outcome = (
+                        ENV_FAILED, (str(e) or "failed").encode(),
+                        type(e).__name__)
+                else:
+                    ts_pair = ((t_recv_us, obs_trace.epoch_us())
+                               if actx is not None else None)
+                    status, payload, outcome = (
+                        ENV_SERVED, encode_result(dets, ts_pair=ts_pair),
+                        "served")
+            if actx is not None:
+                self._close_agent_trace(actx, root_sid, ctx.parent,
+                                        t_recv_us, outcome)
+            entries.append((status, payload))
+        self._reply_frame(encode_result_envelope(entries))
+
     def do_GET(self):  # noqa: N802
         agent = self.server.agent
         try:
@@ -729,11 +808,14 @@ class _AgentHandler(BaseHTTPRequestHandler):
                 buf = self._read_body()
                 d0 = time.monotonic()
                 try:
-                    data, im_info, timeout_ms, ctx = \
-                        decode_prepared_ex(buf)
+                    # v1 fp32 canvases and v2 u8 source frames decode
+                    # through the same versioned entry point; typed
+                    # rejection (400) either way
+                    frame = decode_frame_ex(buf)
                 except ValueError as e:
                     self._reply_json(400, {"error": str(e)})
                     return
+                ctx = frame.ctx
                 actx = None
                 root_sid = 0
                 if ctx is not None:
@@ -743,11 +825,27 @@ class _AgentHandler(BaseHTTPRequestHandler):
                         actx, "agent.decode",
                         (time.monotonic() - d0) * 1e3,
                         bytes=len(buf))
-                req = agent.router.submit_prepared(
-                    data, im_info, data.shape[:2], timeout_ms=timeout_ms,
-                    tctx=actx)
-                self._wait_and_reply(req, timeout_ms, binary=True,
+                req = self._submit_wire_frame(agent, frame, actx)
+                self._wait_and_reply(req, frame.timeout_ms, binary=True,
                                      ctx=ctx, root_sid=root_sid,
+                                     t_recv_us=t_recv_us)
+            elif self.path == "/frames":
+                t_recv_us = obs_trace.epoch_us()
+                buf = self._read_body()
+                d0 = time.monotonic()
+                try:
+                    # the head builds envelopes itself, so ANY malformed
+                    # member means corruption: reject the WHOLE envelope
+                    # (400) — never serve a prefix of it
+                    frames = [decode_frame_ex(f)
+                              for f in decode_envelope(buf)]
+                except ValueError as e:
+                    self._reply_json(400, {"error": str(e)})
+                    return
+                self._serve_envelope(agent, frames,
+                                     decode_ms=(time.monotonic() - d0)
+                                     * 1e3,
+                                     nbytes=len(buf),
                                      t_recv_us=t_recv_us)
             elif self.path == "/prepared_json":
                 t_recv_us = obs_trace.epoch_us()

@@ -15,7 +15,12 @@ inference:
   ``submit_prepared``\\ s each fp32 canvas row into its bucket lane
   (``serve/fleet.py``), bounded by ``bulk.max_inflight`` in-flight
   images (backpressure: the feeder blocks, queues never grow past the
-  shed watermark);
+  shed watermark).  The staging plane already holds NORMALIZED fp32
+  canvases, so bulk deliberately stays on the v1 fp32 wire frame
+  across hosts — re-deriving u8 source pixels to save bytes would
+  cost a quantize/normalize round trip per image; the v2 u8 data
+  plane (``serve/remote.py``, ISSUE 20) is the ONLINE head's win,
+  where the u8 source image is what the head naturally holds;
 * **scoring** — the production request path end to end: per-bucket
   coalescing into static micro-batches, the bit-equality-pinned
   postprocess, ``detections_from_keep`` demux, fleet-wide

@@ -41,7 +41,7 @@ import numpy as np
 from mx_rcnn_tpu.config import Config
 from mx_rcnn_tpu.core.tester import (Predictor, _postprocess_batch,
                                      detections_from_keep, tiled_bbox_stats)
-from mx_rcnn_tpu.data.image import resize_to_bucket
+from mx_rcnn_tpu.data.image import pad_normalize, resize_to_bucket
 from mx_rcnn_tpu.obs import trace as obs_trace
 from mx_rcnn_tpu.serve.metrics import ServeMetrics
 from mx_rcnn_tpu.serve.queue import (EXPIRED, FAILED, SERVED, SHED,
@@ -189,6 +189,58 @@ class ServingEngine:
         t = (self.cfg.serve.default_timeout_ms if timeout_ms is None
              else timeout_ms)
         deadline = now + t / 1000.0 if t and t > 0 else None
+        req = ServeRequest(data, np.asarray(im_info, np.float32), bucket,
+                           deadline, now)
+        req.tctx = tctx
+        self._trace_admit(req)
+        self.metrics.count("submitted")
+        if self._closed or not self.queues[bucket].offer(req):
+            req._finish(SHED)
+            self.metrics.count("shed")
+        return req
+
+    def submit_source(self, img: np.ndarray, im_info: np.ndarray,
+                      bucket: Tuple[int, int],
+                      timeout_ms: float = None,
+                      tctx: "obs_trace.TraceContext" = None
+                      ) -> ServeRequest:
+        """v2 wire admission seam (``serve/remote.py`` u8 source
+        frames): admit one resized-but-UNNORMALIZED (h, w, 3) uint8
+        image whose bucket and im_info the head already resolved — this
+        side pays only pad+normalize.  That step is ``data/image.py
+        pad_normalize``, the SAME function every head-side preprocess
+        tail ends with, so the canvas built here is bit-equal to the
+        one the head would have shipped as a v1 fp32 frame (pinned by
+        tests/test_wire_v2.py).  The watermark pre-check runs BEFORE
+        the pixel work (the :meth:`submit` idiom: a shed request must
+        not pay normalization); everything downstream is the standard
+        prepared path."""
+        bucket = tuple(bucket)
+        if bucket not in self.queues:
+            raise ValueError(f"bucket {bucket} is not a configured shape "
+                             f"bucket {sorted(self.queues)}")
+        img = np.asarray(img)
+        if img.dtype != np.uint8 or img.ndim != 3 or img.shape[2] != 3:
+            raise ValueError(f"source image must be uint8 (h, w, 3), "
+                             f"got {img.dtype} {tuple(img.shape)}")
+        h, w = img.shape[:2]
+        if h > bucket[0] or w > bucket[1]:
+            raise ValueError(f"source image ({h}, {w}) does not fit "
+                             f"bucket {bucket}")
+        now = time.monotonic()
+        t = (self.cfg.serve.default_timeout_ms if timeout_ms is None
+             else timeout_ms)
+        deadline = now + t / 1000.0 if t and t > 0 else None
+        if self._closed or (len(self.queues[bucket])
+                            >= self.queues[bucket].shed_watermark):
+            req = ServeRequest(None, None, bucket, deadline, now)
+            req.tctx = tctx
+            self._trace_admit(req)
+            self.metrics.count("submitted")
+            req._finish(SHED)
+            self.metrics.count("shed")
+            return req
+        data = pad_normalize(img, self.cfg.network.pixel_means, bucket)
         req = ServeRequest(data, np.asarray(im_info, np.float32), bucket,
                            deadline, now)
         req.tctx = tctx

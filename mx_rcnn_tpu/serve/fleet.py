@@ -103,12 +103,13 @@ class FleetRequest(ServeRequest):
     a drained burst holds no pixel memory.
     """
 
-    __slots__ = ("attempts", "tried", "replica_id", "prepared", "version",
-                 "tparent")
+    __slots__ = ("attempts", "tried", "replica_id", "prepared", "source",
+                 "version", "tparent")
 
     def __init__(self, image: np.ndarray, deadline: Optional[float],
                  now: float, im_info: np.ndarray = None,
-                 bucket: Tuple[int, int] = None, prepared: bool = False):
+                 bucket: Tuple[int, int] = None, prepared: bool = False,
+                 source: bool = False):
         super().__init__(image, im_info, bucket, deadline, now)
         self.attempts = 0          # dispatches so far (1 = no reroute)
         self.tried: set = set()    # replica ids already dispatched to
@@ -121,6 +122,12 @@ class FleetRequest(ServeRequest):
         # through ``ServingEngine.submit_prepared`` (a reroute re-offers
         # the same canvas; there is no raw image to re-resize)
         self.prepared = prepared
+        # v2 wire plane (serve/remote.py): image is the resized-but-
+        # unnormalized u8 source with bucket/im_info already resolved —
+        # dispatch goes through ``submit_source`` (local engines
+        # pad+normalize at admission, remote engines ship the small u8
+        # frame; a reroute re-offers the SAME source bytes elsewhere)
+        self.source = source
         # distributed tracing: the span id this request's root span
         # nests under (0 = head-originated; inbound contexts carry the
         # upstream parent).  ``tctx``'s own parent is the ROOT span id
@@ -640,6 +647,30 @@ class FleetRouter:
         self._dispatch(freq)
         return freq
 
+    def submit_source(self, img: np.ndarray, im_info: np.ndarray,
+                      bucket: Tuple[int, int],
+                      timeout_ms: float = None,
+                      tctx: "obs_trace.TraceContext" = None
+                      ) -> FleetRequest:
+        """v2 wire admission (``serve/agent.py`` u8 source frames):
+        route one resized-but-unnormalized u8 image into its bucket
+        lane fleet-wide.  Same JSQ spread, deadline authority, reroute
+        and exactly-once accounting as :meth:`submit_prepared`; the
+        SOURCE pixels ride the request, so every (re)dispatch offers
+        the same bytes — a local engine runs the shared pad_normalize
+        at admission, a remote engine re-ships the 1 B/px frame."""
+        now = time.monotonic()
+        t = (self.cfg.serve.default_timeout_ms if timeout_ms is None
+             else timeout_ms)
+        deadline = now + t / 1000.0 if t and t > 0 else None
+        freq = FleetRequest(np.asarray(img), deadline, now,
+                            im_info=np.asarray(im_info, np.float32),
+                            bucket=tuple(bucket), source=True)
+        self._trace_admit(freq, tctx)
+        self.metrics.count("submitted")
+        self._dispatch(freq)
+        return freq
+
     @staticmethod
     def _trace_admit(freq: FleetRequest,
                      tctx: "obs_trace.TraceContext") -> None:
@@ -761,7 +792,17 @@ class FleetRouter:
         # reconstructs as ONE trace with both attempt subtrees
         inner_ctx = (freq.tctx.child(obs_trace.new_span_id())
                      if freq.tctx is not None else None)
-        if freq.prepared:
+        if freq.source:
+            if inner_ctx is not None:
+                inner = eng.submit_source(freq.image, freq.im_info,
+                                          freq.bucket,
+                                          timeout_ms=remaining_ms,
+                                          tctx=inner_ctx)
+            else:
+                inner = eng.submit_source(freq.image, freq.im_info,
+                                          freq.bucket,
+                                          timeout_ms=remaining_ms)
+        elif freq.prepared:
             if inner_ctx is not None:
                 inner = eng.submit_prepared(freq.image, freq.im_info,
                                             freq.bucket,

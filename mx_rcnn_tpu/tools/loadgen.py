@@ -782,8 +782,28 @@ def main(argv=None) -> int:
     p.add_argument("--min_crosshost_scaling", type=float, default=1.9,
                    help="--check floor for 2-host stub scaling (the "
                         "4-host floor is 2x this)")
+    p.add_argument("--wire_bench", action="store_true",
+                   help="run the wire data-plane battery (v1-fp32 / "
+                        "v2-u8 / +coalesce / +adaptive arms + SIGKILL-"
+                        "mid-envelope — tools/wire_bench.py) and emit "
+                        "WIRE_r20-style JSON")
+    p.add_argument("--wire_smoke", action="store_true",
+                   help="gate-scale --wire_bench for `make wire-smoke` "
+                        "(short windows, same arms and kill leg)")
+    p.add_argument("--max_wire_bytes_ratio", type=float, default=0.30,
+                   help="--check ceiling for v2-u8/v1-fp32 bytes per "
+                        "image (measured counters AND production-"
+                        "bucket codec math)")
+    p.add_argument("--min_wire_speedup", type=float, default=1.8,
+                   help="--check floor for the coalesced-arm/v1-arm "
+                        "wire-leg throughput ratio")
     add_set_arg(p)
     args = p.parse_args(argv)
+
+    if args.wire_bench or args.wire_smoke:
+        from mx_rcnn_tpu.tools.wire_bench import run_wire_bench
+
+        return run_wire_bench(args)
 
     if args.crosshost_bench or args.crosshost_smoke:
         from mx_rcnn_tpu.tools.crosshost import run_crosshost_bench

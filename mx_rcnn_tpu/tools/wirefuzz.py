@@ -6,7 +6,11 @@ this driver points it at four targets and records the verdicts:
 
 * **codec** — every mutation against the in-process MXR1/MXD1
   decoders (``serve/remote.py``) under the allocation guard and a
-  wall-clock deadline: malformed frames must die as ``ValueError``;
+  wall-clock deadline: malformed frames must die as ``ValueError``.
+  Covers v1 fp32 frames, v2 u8 source frames (dtype-tag confusion, a
+  u8 frame claiming an fp32 length), multi-frame envelopes
+  (count-prefix lies, per-member truncation/inflation, poisoned
+  members) and both result framings;
 * **agent** — a LIVE per-host agent (content-stub replicas): mutated
   frames over real HTTP must come back 4xx (never 5xx, never a wedged
   handler), plus the HTTP-level attacks — multi-GB Content-Length
@@ -22,11 +26,13 @@ this driver points it at four targets and records the verdicts:
   agents: every submitted frame must reach exactly one terminal state
   and the healthy lane keeps serving (reroute, exactly-once).
 
-Two PLANTED ARMS prove sensitivity (a fuzzer that cannot catch a
+Three PLANTED ARMS prove sensitivity (a fuzzer that cannot catch a
 seeded bug proves nothing): a zero-fill-on-short-read decoder variant
-(accepts truncated frames → flagged) and an uncapped-length variant
-(allocates off the wire's row count → the alloc guard flags it).
-Both also carry netlint waivers — the static layer flags them too.
+(accepts truncated frames → flagged), an uncapped-length variant
+(allocates off the wire's row count → the alloc guard flags it), and
+a trusting-envelope variant (believes count/length prefixes, zero-
+fills short members → flagged).  All carry netlint waivers — the
+static layer flags them too.
 
 Results land in ``NETFUZZ_r16.json``; ``--smoke`` is the ~1-minute
 ``make wirefuzz-smoke`` subset wired into ``make test-gate``
@@ -55,13 +61,20 @@ from mx_rcnn_tpu.analysis.wirefuzz import (ACCEPTED_VALID, ALLOC,
                                            http_post_raw, run_case,
                                            summarize)
 from mx_rcnn_tpu.obs import trace as obs_trace
-from mx_rcnn_tpu.serve.remote import (_REQ_HEAD, _RESP_ENTRY,
+from mx_rcnn_tpu.serve.remote import (_ENV_HEAD, _ENV_LEN, _REQ_HEAD,
+                                      _REQ_HEAD2, _RESP_ENTRY,
                                       _RESP_HEAD, _RESP_TRACE_EXT,
+                                      DTYPE_F32, ENV_MAGIC,
+                                      ENV_VERSION, MAX_ENV_FRAMES,
                                       RESULT_MAGIC, WIRE_MAGIC,
-                                      decode_prepared,
+                                      WIRE_VERSION_SRC, decode_envelope,
+                                      decode_frame_ex, decode_prepared,
                                       decode_prepared_ex, decode_result,
+                                      decode_result_envelope,
                                       decode_result_ex, encode_prepared,
-                                      encode_result)
+                                      encode_result,
+                                      encode_result_envelope,
+                                      encode_source)
 
 logger = logging.getLogger("mx_rcnn_tpu")
 
@@ -222,6 +235,144 @@ def result_corpus(seed: int) -> List[Mutation]:
                                 RES_BENIGN_SPANS, extra=extra)
 
 
+# MXR1 v2 header ("<4sHHHHHHHHf3f", PR-20): the dtype TAG and the
+# (h, w, c) payload sizing are load-bearing — a flip must reject off
+# the dtype/length disagreement, never reinterpret the pixels.  The
+# BUCKET dims are data at codec level (the agent's configured-bucket
+# check owns them; a flip below h rejects, above merely retargets), so
+# they sit in the benign set with the timeout and im_info.
+REQ2_REJECT_SPANS = [("magic", 0, 4), ("version", 4, 6),
+                     ("dtype", 6, 8), ("h", 8, 10), ("w", 10, 12),
+                     ("c", 12, 14), ("flags", 18, 20)]
+REQ2_BENIGN_SPANS = [("bh", 14, 16), ("bw", 16, 18),
+                     ("timeout", 20, 24), ("im_info", 24, 36)]
+
+
+def _source_frame(bucket=(16, 24), hw=(12, 20), seed=0) -> bytes:
+    rng = np.random.RandomState(seed)
+    img = rng.randint(0, 256, size=(hw[0], hw[1], 3), dtype=np.uint8)
+    info = np.array([hw[0], hw[1], 1.0], np.float32)
+    return encode_source(img, info, bucket, 500.0)
+
+
+def _f32_partial_frame(bucket=(16, 24), hw=(12, 20)) -> bytes:
+    """Hand-packed v2 fp32 frame SMALLER than its bucket — no encoder
+    produces this (fp32 v2 means a full canvas), so it is pure wire
+    corruption the decoder must refuse."""
+    payload = np.zeros((hw[0], hw[1], 3), np.float32).tobytes()
+    head = _REQ_HEAD2.pack(WIRE_MAGIC, WIRE_VERSION_SRC, DTYPE_F32,
+                           hw[0], hw[1], 3, bucket[0], bucket[1], 0,
+                           500.0, float(hw[0]), float(hw[1]), 1.0)
+    return head + payload
+
+
+def source_corpus(seed: int) -> List[Mutation]:
+    """v2 u8 source-frame arms: dtype-tag confusion and dtype/length
+    lies on top of the generic header/truncation/flip corpus."""
+    frame = _source_frame(seed=seed)
+    as_f32 = bytearray(frame)
+    struct.pack_into("<H", as_f32, 6, DTYPE_F32)
+    unknown = bytearray(frame)
+    struct.pack_into("<H", unknown, 6, 7)
+    inflate = bytearray(frame)
+    struct.pack_into("<HH", inflate, 8, 0x7FFF, 0x7FFF)
+    extra = [
+        # u8 pixels re-tagged fp32: the length disagreement (1 B/px on
+        # the wire, 4 B/px claimed) must reject — NEVER reinterpret
+        Mutation("v2:dtype-u8-claims-f32", bytes(as_f32), True),
+        # a u8 frame shipped with an fp32-sized payload (4x too long)
+        Mutation("v2:u8-with-f32-length",
+                 frame + b"\0" * (len(frame) - _REQ_HEAD2.size) * 3,
+                 True),
+        Mutation("v2:dtype-unknown=7", bytes(unknown), True),
+        # dims claim 32767^2 over the same small payload: refuse off
+        # the length mismatch, allocating nothing
+        Mutation("v2:inflate:dims", bytes(inflate), True),
+        # fp32 v2 frame that is not a full canvas
+        Mutation("v2:f32-partial-canvas", _f32_partial_frame(), True),
+    ]
+    return Mutator(seed).corpus(frame, _REQ_HEAD2.size,
+                                REQ2_REJECT_SPANS, REQ2_BENIGN_SPANS,
+                                extra=extra)
+
+
+def _envelope(frames: List[bytes], count: int = None) -> bytes:
+    n = len(frames) if count is None else count
+    return b"".join([_ENV_HEAD.pack(ENV_MAGIC, ENV_VERSION, n)]
+                    + [_ENV_LEN.pack(len(f)) + f for f in frames])
+
+
+def _decode_envelope_frames(buf):
+    """The agent's composite: envelope split, then every member frame
+    decoded — ANY malformed member rejects the whole envelope."""
+    return [decode_frame_ex(f) for f in decode_envelope(buf)]
+
+
+# request envelope header: magic, version, count, then the first
+# member's length prefix — every one load-bearing
+ENV_REJECT_SPANS = [("magic", 0, 4), ("version", 4, 6),
+                    ("count", 6, 8), ("len0", 8, 12)]
+
+
+def envelope_corpus(seed: int) -> List[Mutation]:
+    """Multi-frame envelope arms: count-prefix lies, length-prefix
+    lies, per-member truncation/inflation, a poisoned member among
+    valid mates — all must reject as a WHOLE envelope."""
+    f1 = _prepared_frame((16, 20), seed)          # v1 fp32 member
+    f2 = _source_frame(seed=seed + 1)             # v2 u8, pads on agent
+    f3 = _source_frame(hw=(16, 24), seed=seed + 2)  # v2 u8 full canvas
+    env = _envelope([f1, f2, f3])
+    len_inflate = bytearray(_envelope([f1]))
+    struct.pack_into("<I", len_inflate, 8, len(f1) + 1000)
+    extra = [
+        Mutation("env:valid-mixed", env, False),
+        Mutation("env:valid-single", _envelope([f2]), False),
+        # count-prefix lies: more frames than shipped, fewer than
+        # shipped (trailing bytes), zero, and over the hard cap
+        Mutation("env:count-over", _envelope([f1, f2], count=3), True),
+        Mutation("env:count-under", _envelope([f1, f2, f3], count=2),
+                 True),
+        Mutation("env:count=0", _envelope([], count=0), True),
+        Mutation("env:count-over-cap",
+                 _envelope([f1], count=MAX_ENV_FRAMES + 1), True),
+        # member length prefix past the bytes actually present
+        Mutation("env:len-inflate", bytes(len_inflate), True),
+        # member truncated under an honest length prefix
+        Mutation("env:member-trunc",
+                 _envelope([f1, f2[:len(f2) // 2], f3]), True),
+        # member inflated under an honest length prefix
+        Mutation("env:member-inflate", _envelope([f1, f3 + b"\0\0"]),
+                 True),
+        # one garbage member between two valid mates
+        Mutation("env:member-poisoned",
+                 _envelope([f1, b"\x07GARBAGE", f3]), True),
+    ]
+    return Mutator(seed).corpus(env, _ENV_HEAD.size + _ENV_LEN.size,
+                                ENV_REJECT_SPANS, extra=extra)
+
+
+def result_envelope_corpus(seed: int) -> List[Mutation]:
+    """Response-envelope arms: per-entry status codes are load-bearing
+    (an unknown terminal must reject, not default), and the entry
+    count/length discipline matches the request side."""
+    ok = encode_result_envelope([(0, _result_frame(seed)), (1, b""),
+                                 (3, b"agent exploded")])
+    bad_status = bytearray(ok)
+    struct.pack_into("<H", bad_status, _ENV_HEAD.size, 9)
+    count_over = bytearray(ok)
+    struct.pack_into("<H", count_over, 6, 4)
+    muts = [
+        Mutation("renv:valid", ok, False),
+        Mutation("renv:status-unknown=9", bytes(bad_status), True),
+        Mutation("renv:count-over", bytes(count_over), True),
+        Mutation("renv:trunc@-1", ok[:-1], True),
+        Mutation("renv:trunc@head", ok[:_ENV_HEAD.size - 2], True),
+        Mutation("renv:inflate+4", ok + b"\0" * 4, True),
+        Mutation("renv:req-magic", ENV_MAGIC + ok[4:], True),
+    ]
+    return muts
+
+
 # ---------------------------------------------------------------------------
 # leg A: in-process codec
 # ---------------------------------------------------------------------------
@@ -240,8 +391,22 @@ def leg_codec(seed: int, smoke: bool = False) -> Dict:
     results += fuzz_codec(decode_prepared_ex,
                           traced_prepared_corpus(seed))
     results += fuzz_codec(decode_result_ex, traced_result_corpus(seed))
+    # v2 source frames + multi-frame envelopes (PR-20): dtype-tag
+    # confusion, count-prefix lies, per-member truncation/inflation —
+    # against decode_frame_ex and the envelope→frame composite.  The
+    # v1 corpus also re-runs through the version-dispatching
+    # decode_frame_ex: the dispatcher must reject exactly what the
+    # pinned v1 decoder rejects
+    results += fuzz_codec(decode_frame_ex, source_corpus(seed + 20))
+    results += fuzz_codec(decode_frame_ex,
+                          prepared_corpus(seed + 21, (16, 20)))
+    results += fuzz_codec(_decode_envelope_frames,
+                          envelope_corpus(seed + 22))
+    results += fuzz_codec(decode_result_envelope,
+                          result_envelope_corpus(seed + 23))
     out = summarize(results)
-    out["target"] = "decode_prepared[_ex]/decode_result[_ex]"
+    out["target"] = ("decode_prepared[_ex]/decode_result[_ex]/"
+                     "decode_frame_ex/decode_[result_]envelope")
     return out
 
 
@@ -393,6 +558,41 @@ def leg_agent(seed: int, smoke: bool = False) -> Dict:
         res = http_post_raw(host, port, "/prepared", good_traced,
                             timeout_s=30.0)
         record("http:tr:good-traced-frame",
+               ACCEPTED_VALID if res.get("status") == 200 else CRASHED,
+               None if res.get("status") == 200 else str(res))
+        # v2 source frames + envelopes over the wire (PR-20): every
+        # must-reject mutation comes back 4xx from /prepared (v2) and
+        # /frames (envelopes) — a poisoned envelope rejects WHOLE
+        smuts = [m for m in source_corpus(seed + 20) if m.must_reject]
+        emuts = [m for m in envelope_corpus(seed + 22) if m.must_reject]
+        if smoke:
+            smuts, emuts = smuts[::4], emuts[::4]
+        for m in smuts:
+            res = http_post_raw(host, port, "/prepared", m.data)
+            record(f"http:{m.name}",
+                   http_case_outcome(res, True, deadline_s),
+                   res.get("error"))
+        for m in emuts:
+            res = http_post_raw(host, port, "/frames", m.data)
+            record(f"http:{m.name}",
+                   http_case_outcome(res, True, deadline_s),
+                   res.get("error"))
+        # ... and the well-formed v2 path serves: a sub-bucket u8
+        # frame (the agent pads) and a two-frame envelope both 200
+        rng2 = np.random.RandomState(seed + 7)
+        src = rng2.randint(0, 256, size=(b[0] - 8, b[1] - 8, 3),
+                           dtype=np.uint8)
+        good_src = encode_source(
+            src, np.array([b[0] - 8, b[1] - 8, 1.0], np.float32), b,
+            10_000.0)
+        res = http_post_raw(host, port, "/prepared", good_src,
+                            timeout_s=30.0)
+        record("http:v2:good-source-frame",
+               ACCEPTED_VALID if res.get("status") == 200 else CRASHED,
+               None if res.get("status") == 200 else str(res))
+        res = http_post_raw(host, port, "/frames",
+                            _envelope([good_src, good]), timeout_s=30.0)
+        record("http:env:good-envelope",
                ACCEPTED_VALID if res.get("status") == 200 else CRASHED,
                None if res.get("status") == 200 else str(res))
         # aftermath: the server still answers /healthz and serves a
@@ -663,6 +863,31 @@ def _decode_result_uncapped(buf: bytes):
     return out
 
 
+def _decode_envelope_trusting(buf):
+    """PLANTED ARM, never wired into serving: trusts the envelope's
+    count and per-member length prefixes — a count lie walks off the
+    buffer (struct.error, not a typed rejection), a short member gets
+    ZERO-FILLED to its declared length instead of rejected, and the
+    trailing-bytes check is absent (an inflated envelope "decodes").
+    wirefuzz must flag all three; the waivers below are netlint seeing
+    the same bugs statically."""
+    # netlint: disable=NL201 planted arm: unpack with no length check
+    magic, _ver, count = _ENV_HEAD.unpack_from(buf)
+    if magic != ENV_MAGIC:
+        raise ValueError(f"bad envelope magic {magic!r}")
+    off = _ENV_HEAD.size
+    frames = []
+    for _ in range(count):
+        # netlint: disable=NL201,NL202 planted arm: trusted length prefix
+        (n,) = _ENV_LEN.unpack_from(buf, off)
+        off += _ENV_LEN.size
+        member = bytes(buf[off:off + n])
+        member += b"\0" * (n - len(member))  # zero-fill the short read
+        frames.append(member)
+        off += n
+    return frames
+
+
 def leg_planted(seed: int) -> Dict:
     # the zero-fill arm sees truncations + flips only: its inflation
     # "acceptance" would be a multi-GB bytes pad, which is the OTHER
@@ -673,15 +898,24 @@ def leg_planted(seed: int) -> Dict:
                             alloc_cap=256 << 20) for m in zf_muts)
     un = summarize(fuzz_codec(_decode_result_uncapped,
                               result_corpus(seed)))
+    # the trusting-envelope arm sees the full envelope corpus: count
+    # lies must crash it (walks off the buffer) and member truncations
+    # must "decode" (zero-filled) — both are violations it cannot hide
+    env = summarize(fuzz_codec(_decode_envelope_trusting,
+                               envelope_corpus(seed + 22)))
     zf_flagged = len(zf["violations"]) > 0
     un_flagged = any(v["outcome"] == ALLOC for v in un["violations"])
+    env_flagged = len(env["violations"]) > 0
     return {
         "zerofill": {"cases": zf["cases"], "outcomes": zf["outcomes"],
                      "flagged": zf_flagged},
         "uncapped": {"cases": un["cases"], "outcomes": un["outcomes"],
                      "alloc_flagged": un_flagged,
                      "flagged": len(un["violations"]) > 0},
-        "ok": zf_flagged and un_flagged,
+        "trusting_envelope": {"cases": env["cases"],
+                              "outcomes": env["outcomes"],
+                              "flagged": env_flagged},
+        "ok": zf_flagged and un_flagged and env_flagged,
     }
 
 

@@ -81,6 +81,9 @@ print(json.dumps({
         "ResNet-101 + ResNet-50; fp8 arm at batch 2",
         "stem channel-pad layout A/B: conv0 3 vs 4 input channels",
         "conv-fusion inspection: traced rollup by HLO op class per network",
+        "cross-host v2-wire A/B against chip-backed agents: v1-fp32 vs "
+        "v2-u8 +coalesce +adaptive at the production bucket (WIRE_r20 "
+        "protocol, real model instead of the content stub)",
     ],
 }))
 EOF
@@ -163,3 +166,12 @@ echo "== 8. conv-fusion inspection (traced rollup by HLO op class) =="
 python -m mx_rcnn_tpu.tools.profile_step --network resnet101 --dataset coco \
     --batch_images 2 --iters 4 --prenms 6000 \
     --trace_dir /tmp/perf_r9_trace --trace_summary
+
+echo "== 9. cross-host v2-wire A/B (chip-backed agents, real model) =="
+# the CPU-measured WIRE_r20 protocol (docs/SERVING.md "Binary wire
+# format") re-run with the agents serving the real checkpointed model:
+# the bytes/image ratio is codec math either way, but the wire-leg
+# speedup and the adaptive depth trajectory depend on real compute
+# latencies behind the wire
+python -m mx_rcnn_tpu.tools.loadgen --wire_bench --check \
+    --out WIRE_r9_chip.json

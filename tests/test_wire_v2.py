@@ -1,0 +1,573 @@
+"""Wire data-plane v2 tests (ISSUE 20): u8 source-pixel frames
+(bit-equal canvas via the shared ``data/image.py pad_normalize``),
+frame coalescing into count-prefixed envelopes, the result envelope's
+per-frame terminal statuses, reroute-after-death of a coalesced
+envelope (every frame terminates exactly once, the trace stays ONE
+N-attempt tree), the AIMD pipeline-depth controller on synthetic RTT
+traces, and the scraped-lane-hint ttl decay regression.
+
+Everything runs in-process and stubbed (quick tier) — the
+multi-PROCESS versions of these claims (real agent subprocesses,
+SIGKILL mid-envelope, measured bytes/image and throughput) are the
+bench's job (``tools/loadgen.py --wire_bench`` → docs/WIRE_r20.json).
+"""
+
+import http.client
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mx_rcnn_tpu.config import generate_config
+from mx_rcnn_tpu.data.image import pad_normalize
+from mx_rcnn_tpu.obs import trace as obs_trace
+from mx_rcnn_tpu.obs.trace import merge_fleet_trace, tree_complete
+from mx_rcnn_tpu.serve.agent import ReplicaAgent, make_agent_server
+from mx_rcnn_tpu.serve.fleet import build_fleet
+from mx_rcnn_tpu.serve.remote import (DTYPE_F32, DTYPE_U8, ENV_FAILED,
+                                      ENV_SERVED, ENVELOPE_CTYPE,
+                                      MAX_ENV_FRAMES, WIRE_VERSION,
+                                      WIRE_VERSION_SRC, _ENV_HEAD,
+                                      _ENV_LEN, _REQ_HEAD2,
+                                      PipelineController, RemoteEngine,
+                                      build_crosshost_router,
+                                      decode_envelope, decode_frame_ex,
+                                      decode_prepared_ex,
+                                      decode_result,
+                                      decode_result_envelope,
+                                      encode_envelope_parts,
+                                      encode_prepared,
+                                      encode_prepared_parts,
+                                      encode_result_envelope,
+                                      encode_source,
+                                      encode_source_parts)
+from mx_rcnn_tpu.tools.loadgen import make_content_stub_run_fn
+
+
+@pytest.fixture(autouse=True)
+def _clean_distributed_state():
+    obs_trace.reset_distributed()
+    yield
+    obs_trace.reset_distributed()
+
+
+def _cfg(**kw):
+    over = {
+        "bucket__scale": 128, "bucket__max_size": 160,
+        "bucket__shapes": ((128, 160), (160, 128)),
+        "serve__batch_size": 2, "serve__max_delay_ms": 5.0,
+        "fleet__health_interval_s": 30.0,
+    }
+    over.update(kw)
+    return generate_config("tiny", "synthetic", **over)
+
+
+def _src(seed=0, hw=(120, 150)):
+    """A sub-bucket u8 source image + its head-computed im_info."""
+    rng = np.random.RandomState(seed)
+    img = rng.randint(0, 256, size=(*hw, 3), dtype=np.uint8)
+    return img, np.array([hw[0], hw[1], 1.0], np.float32)
+
+
+def _start_agent(cfg, model_ms=0.0):
+    ag = ReplicaAgent(cfg, None, {}, run_fn_factory=(
+        lambda rid: make_content_stub_run_fn(cfg, model_ms)))
+    srv = make_agent_server(ag, "127.0.0.1", 0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return ag, srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def _stop_agent(ag, srv):
+    srv.shutdown()
+    srv.server_close()
+    ag.close()
+
+
+def _det_key(dets):
+    return b"".join(np.ascontiguousarray(dets[c], np.float32).tobytes()
+                    for c in sorted(dets))
+
+
+# ---------------------------------------------------------------------------
+# v2 frame codec
+# ---------------------------------------------------------------------------
+
+def test_codec_source_round_trip_bit_equal():
+    img, info = _src(seed=3)
+    b = (128, 160)
+    buf = encode_source(img, info, b, 1234.5)
+    f = decode_frame_ex(buf)
+    assert f.version == WIRE_VERSION_SRC and f.dtype == DTYPE_U8
+    assert f.data.dtype == np.uint8 and f.data.shape == img.shape
+    assert f.data.tobytes() == img.tobytes()   # bit-equal, not close
+    assert f.bucket == b
+    assert f.im_info.tobytes() == info.tobytes()
+    assert f.timeout_ms == np.float32(1234.5)
+    assert f.ctx is None
+    # 1 B/px on the wire: header + h*w*3, nothing else
+    assert len(buf) == _REQ_HEAD2.size + img.size
+
+
+def test_codec_source_parts_are_zero_copy():
+    """The pixel payload rides as a memoryview of the caller's array —
+    sendmsg iovecs, no staging copy."""
+    img, info = _src(seed=4)
+    parts = encode_source_parts(img, info, (128, 160), 0.0)
+    assert len(parts) == 2
+    assert isinstance(parts[1], memoryview)
+    assert np.shares_memory(np.frombuffer(parts[1], np.uint8), img)
+    assert b"".join(parts) == encode_source(img, info, (128, 160), 0.0)
+
+
+def test_codec_frame_ex_decodes_v1_identically():
+    """decode_frame_ex is the version dispatcher: a v1 frame through it
+    must equal the pinned v1-only decode_prepared_ex, tagged fp32."""
+    rng = np.random.RandomState(5)
+    data = (rng.rand(128, 160, 3) * 255.0).astype(np.float32)
+    info = np.array([128, 160, 1.0], np.float32)
+    buf = encode_prepared(data, info, 777.0)
+    f = decode_frame_ex(buf)
+    d1, i1, t1, c1 = decode_prepared_ex(buf)
+    assert f.version == WIRE_VERSION and f.dtype == DTYPE_F32
+    assert f.data.tobytes() == d1.tobytes()
+    assert f.bucket == (128, 160)
+    assert f.im_info.tobytes() == i1.tobytes()
+    assert f.timeout_ms == t1 and f.ctx is None and c1 is None
+
+
+def test_codec_source_rejects_malformed():
+    img, info = _src(seed=6, hw=(12, 20))
+    b = (16, 24)
+    buf = encode_source(img, info, b, 0.0)
+    want = len(buf)
+
+    def patched(off, fmt, val):
+        m = bytearray(buf)
+        struct.pack_into(fmt, m, off, val)
+        return bytes(m)
+
+    with pytest.raises(ValueError):
+        decode_frame_ex(buf[:6])                  # truncated head
+    with pytest.raises(ValueError):
+        decode_frame_ex(buf[:want - 1])           # truncated payload
+    with pytest.raises(ValueError):
+        decode_frame_ex(buf + b"\0")              # trailing byte
+    with pytest.raises(ValueError):
+        decode_frame_ex(b"XXXX" + buf[4:])        # bad magic
+    with pytest.raises(ValueError):
+        decode_frame_ex(patched(4, "<H", 9))      # unknown version
+    with pytest.raises(ValueError):
+        decode_frame_ex(patched(6, "<H", 7))      # unknown dtype tag
+    with pytest.raises(ValueError):
+        decode_frame_ex(patched(12, "<H", 4))     # c != 3
+    with pytest.raises(ValueError):
+        decode_frame_ex(patched(18, "<H", 0x80))  # unknown flags
+    with pytest.raises(ValueError):
+        decode_frame_ex(patched(8, "<H", 17))     # h > bh
+    # dtype/length confusion: a u8 frame retagged fp32 must never be
+    # reinterpreted (length disagrees), and padding a u8 frame out to
+    # the fp32 length must not make the retag acceptable either
+    with pytest.raises(ValueError):
+        decode_frame_ex(patched(6, "<H", DTYPE_F32))
+    inflated = bytearray(patched(6, "<H", DTYPE_F32))
+    inflated += b"\0" * (img.size * 3)            # now fp32-sized
+    with pytest.raises(ValueError):               # ...but partial canvas
+        decode_frame_ex(bytes(inflated))
+    with pytest.raises(ValueError):               # u8 with fp32 length
+        decode_frame_ex(buf + b"\0" * (img.size * 3))
+    # fp32 v2 frames must be FULL canvases
+    full = np.zeros((16, 24, 3), np.float32)
+    head = _REQ_HEAD2.pack(b"MXR1", WIRE_VERSION_SRC, DTYPE_F32,
+                           12, 20, 3, 16, 24, 0, 0.0, 12.0, 20.0, 1.0)
+    with pytest.raises(ValueError):
+        decode_frame_ex(head + full[:12, :20].tobytes())
+    # trace flag without the extension blob
+    with pytest.raises(ValueError):
+        decode_frame_ex(patched(18, "<H", 0x1))
+    # encoder-side validations
+    with pytest.raises(ValueError):
+        encode_source(img.astype(np.float32), info, b, 0.0)
+    with pytest.raises(ValueError):
+        encode_source(img[..., 0], info, b, 0.0)
+    with pytest.raises(ValueError):
+        encode_source(img, info, (8, 8), 0.0)     # does not fit
+
+
+def test_codec_source_trace_extension_round_trip():
+    obs_trace.configure_distributed(sample=1.0, ring=64, host="head")
+    ctx = obs_trace.sample_trace()
+    assert ctx is not None
+    img, info = _src(seed=7, hw=(12, 20))
+    f = decode_frame_ex(encode_source(img, info, (16, 24), 50.0,
+                                      ctx=ctx))
+    assert f.ctx is not None and f.ctx.trace_id == ctx.trace_id
+    assert f.data.tobytes() == img.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# envelopes
+# ---------------------------------------------------------------------------
+
+def _envelope(frames):
+    parts = encode_envelope_parts([[f] for f in frames])
+    return b"".join(bytes(p) for p in parts)
+
+
+def test_codec_envelope_round_trip_mixed_versions():
+    img, sinfo = _src(seed=8, hw=(12, 20))
+    v2 = encode_source(img, sinfo, (16, 24), 10.0)
+    rng = np.random.RandomState(9)
+    v1 = encode_prepared((rng.rand(16, 24, 3) * 255).astype(np.float32),
+                         np.array([16, 24, 1.0], np.float32), 20.0)
+    buf = _envelope([v2, v1, v2])
+    members = decode_envelope(buf)
+    assert members == [v2, v1, v2]
+    kinds = [decode_frame_ex(m).dtype for m in members]
+    assert kinds == [DTYPE_U8, DTYPE_F32, DTYPE_U8]
+    # coalescing overhead is exactly the head + one length per frame
+    assert len(buf) == (_ENV_HEAD.size + 3 * _ENV_LEN.size
+                        + len(v2) * 2 + len(v1))
+
+
+def test_codec_envelope_rejects_malformed():
+    img, info = _src(seed=10, hw=(12, 20))
+    fr = encode_source(img, info, (16, 24), 0.0)
+    buf = _envelope([fr, fr])
+
+    def patched(off, fmt, val):
+        m = bytearray(buf)
+        struct.pack_into(fmt, m, off, val)
+        return bytes(m)
+
+    with pytest.raises(ValueError):
+        decode_envelope(buf[:4])                    # truncated head
+    with pytest.raises(ValueError):
+        decode_envelope(b"XXXX" + buf[4:])          # bad magic
+    with pytest.raises(ValueError):
+        decode_envelope(patched(4, "<H", 2))        # bad version
+    with pytest.raises(ValueError):
+        decode_envelope(patched(6, "<H", 0))        # count = 0
+    with pytest.raises(ValueError):
+        decode_envelope(patched(6, "<H", 3))        # count lies high
+    with pytest.raises(ValueError):
+        decode_envelope(patched(6, "<H", 1))        # count lies low
+    with pytest.raises(ValueError):
+        decode_envelope(patched(6, "<H", MAX_ENV_FRAMES + 1))
+    with pytest.raises(ValueError):                 # length-prefix lie
+        decode_envelope(patched(_ENV_HEAD.size, "<I", len(fr) + 1000))
+    with pytest.raises(ValueError):
+        decode_envelope(buf[:-3])                   # member truncated
+    with pytest.raises(ValueError):
+        decode_envelope(buf + b"\0\0")              # trailing bytes
+    with pytest.raises(ValueError):
+        encode_envelope_parts([])                   # empty envelope
+    with pytest.raises(ValueError):
+        encode_envelope_parts([[fr]] * (MAX_ENV_FRAMES + 1))
+    # a malformed MEMBER survives the envelope layer but fails the
+    # per-frame decode the caller runs
+    poisoned = _envelope([fr, fr[:-2]])   # member 1 short of its header
+    with pytest.raises(ValueError):
+        [decode_frame_ex(m) for m in decode_envelope(poisoned)]
+
+
+def test_codec_result_envelope_round_trip_and_rejections():
+    entries = [(ENV_SERVED, b"payload"), (ENV_FAILED, b"err"),
+               (ENV_SERVED, b"")]
+    buf = encode_result_envelope(entries)
+    assert decode_result_envelope(buf) == entries
+
+    def patched(off, fmt, val):
+        m = bytearray(buf)
+        struct.pack_into(fmt, m, off, val)
+        return bytes(m)
+
+    with pytest.raises(ValueError):
+        decode_result_envelope(buf[:5])
+    with pytest.raises(ValueError):                 # request magic
+        decode_result_envelope(b"MXE1" + buf[4:])
+    with pytest.raises(ValueError):                 # unknown status
+        decode_result_envelope(patched(_ENV_HEAD.size, "<H", 9))
+    with pytest.raises(ValueError):                 # count lies high
+        decode_result_envelope(patched(6, "<H", 4))
+    with pytest.raises(ValueError):
+        decode_result_envelope(buf[:-1])
+    with pytest.raises(ValueError):
+        decode_result_envelope(buf + b"\0")
+
+
+# ---------------------------------------------------------------------------
+# pad_normalize bit-equality: head-built canvas ≡ agent-built canvas
+# ---------------------------------------------------------------------------
+
+def test_source_path_bit_equal_to_prepared_and_inprocess():
+    """THE v2 correctness pin: the same u8 pixels through (a) the local
+    router with a head-side pad_normalize, (b) the remote v1 prepared
+    path, and (c) the remote v2 source path must produce IDENTICAL
+    detections — the content stub hashes the batch bytes, so a single
+    differing canvas byte shows up as a diff."""
+    cfg = _cfg(fleet__replicas=1)
+    local = build_fleet(cfg, None, {}, run_fn_factory=(
+        lambda rid: make_content_stub_run_fn(cfg)))
+    ag, srv, url = _start_agent(cfg)
+    try:
+        b = tuple(cfg.bucket.shapes[0])
+        img, info = _src(seed=11)
+        canvas = pad_normalize(img, cfg.network.pixel_means, b)
+        want = local.submit_prepared(canvas, info, b,
+                                     timeout_ms=10_000).wait(20.0)
+        assert want, "in-process baseline produced no detections"
+        eng = RemoteEngine("t-v2eq", url, cfg)
+        try:
+            got_v1 = eng.submit_prepared(canvas, info, b,
+                                         timeout_ms=10_000).wait(20.0)
+            got_v2 = eng.submit_source(img, info, b,
+                                       timeout_ms=10_000).wait(20.0)
+            assert _det_key(got_v1) == _det_key(want)
+            assert _det_key(got_v2) == _det_key(want)
+        finally:
+            eng.close()
+    finally:
+        _stop_agent(ag, srv)
+        local.close()
+
+
+def test_submit_source_validations():
+    cfg = _cfg()
+    ag, srv, url = _start_agent(cfg)
+    eng = RemoteEngine("t-v2val", url, cfg)
+    try:
+        b = tuple(cfg.bucket.shapes[0])
+        img, info = _src(seed=12)
+        with pytest.raises(ValueError):             # fp32 source image
+            eng.submit_source(img.astype(np.float32), info, b)
+        with pytest.raises(ValueError):             # not (h, w, 3)
+            eng.submit_source(img[..., 0], info, b)
+        with pytest.raises(ValueError):             # does not fit
+            eng.submit_source(img, info, (64, 64))
+    finally:
+        eng.close()
+        _stop_agent(ag, srv)
+
+
+# ---------------------------------------------------------------------------
+# coalescing
+# ---------------------------------------------------------------------------
+
+def test_coalescing_packs_envelopes_on_one_connection():
+    """A burst behind one connection must coalesce: frames queued while
+    a send is in flight pack into envelopes (serve.envelopes > 0), the
+    keep-alive pin holds (conns_opened == 1), and every frame is
+    accounted exactly once on the wire counters."""
+    cfg = _cfg(crosshost__connections=1, crosshost__pipeline_depth=16,
+               crosshost__frames_per_send=4)
+    ag, srv, url = _start_agent(cfg, model_ms=2.0)
+    eng = RemoteEngine("t-coalesce", url, cfg)
+    try:
+        b = tuple(cfg.bucket.shapes[0])
+        reqs = []
+        for i in range(16):
+            img, info = _src(seed=20 + i)
+            reqs.append(eng.submit_source(img, info, b,
+                                          timeout_ms=20_000))
+        for r in reqs:
+            assert r.wait(30.0) is not None
+        assert eng.conns_opened == 1
+        reg = eng.metrics.registry
+        assert reg.counter("serve.envelopes") >= 1
+        assert reg.counter("serve.wire_frames") == 16
+        assert reg.counter("serve.wire_sends") < 16  # amortized sends
+        assert eng.metrics.in_flight() == 0
+    finally:
+        eng.close()
+        _stop_agent(ag, srv)
+
+
+def test_agent_envelope_member_failure_is_isolated():
+    """A well-formed frame the agent cannot serve (unconfigured bucket)
+    fails ALONE inside its envelope — its mates still serve (per-frame
+    terminal statuses, satellite of ISSUE 20)."""
+    cfg = _cfg()
+    ag, srv, url = _start_agent(cfg)
+    try:
+        b = tuple(cfg.bucket.shapes[0])
+        img, info = _src(seed=30)
+        good = encode_source(img, info, b, 15_000.0)
+        odd_img, odd_info = _src(seed=31, hw=(60, 60))
+        odd = encode_source(odd_img, odd_info, (96, 96), 15_000.0)
+        body = _envelope([good, odd, good])
+        host, port = srv.server_address
+        conn = http.client.HTTPConnection(host, port, timeout=30.0)
+        try:
+            conn.request("POST", "/frames", body=body,
+                         headers={"Content-Type": ENVELOPE_CTYPE})
+            resp = conn.getresponse()
+            payload = resp.read()
+        finally:
+            conn.close()
+        assert resp.status == 200
+        entries = decode_result_envelope(payload)
+        assert [s for s, _ in entries] == [ENV_SERVED, ENV_FAILED,
+                                           ENV_SERVED]
+        for status, p in entries:
+            if status == ENV_SERVED:
+                assert decode_result(p)   # a real MXD1 result frame
+    finally:
+        _stop_agent(ag, srv)
+
+
+# ---------------------------------------------------------------------------
+# reroute after host death mid-coalesced-envelope (satellite c)
+# ---------------------------------------------------------------------------
+
+def test_envelope_reroute_after_host_death_single_trace():
+    """Kill a host holding coalesced envelopes: every member frame must
+    terminate EXACTLY once (rerouted to the survivor, served inside the
+    original deadline — no loss, no duplicate terminals) and each
+    request's trace stays ONE tree holding both the failed wire attempt
+    and the served one."""
+    cfg = _cfg(crosshost__connections=1, crosshost__pipeline_depth=16,
+               crosshost__frames_per_send=4,
+               crosshost__dead_after_failures=2,
+               crosshost__scrape_interval_s=0.1,
+               fleet__health_interval_s=0.1,
+               fleet__reroute_retries=3,
+               obs__trace_sample=1.0, obs__trace_slow_pct=0.0)
+    obs_trace.configure_distributed(sample=1.0, ring=256, slow_pct=0.0,
+                                    host="head")
+    agents = [_start_agent(cfg) for _ in range(2)]
+    router, feed = build_crosshost_router(cfg, [a[2] for a in agents])
+    try:
+        # no traffic yet: the engines' worker sockets are lazy, so
+        # closing the victim's listener kills the host completely —
+        # its first envelope send fails in flight and must reroute
+        _stop_agent(*agents[1][:2])
+        t0 = time.monotonic()
+        n = 8
+        reqs = []
+        for i in range(n):
+            img, info = _src(seed=40 + i)
+            reqs.append(router.submit_source(
+                img, info, tuple(cfg.bucket.shapes[0]),
+                timeout_ms=15_000))
+        for r in reqs:
+            assert r.wait(20.0) is not None   # SERVED, never lost
+        assert time.monotonic() - t0 < 15.0   # original budget held
+        snap = router.metrics.snapshot()["counters"]
+        assert snap["served"] == n            # exactly once each
+        assert snap["failed"] == 0 and snap["expired"] == 0
+        # every trace settles as ONE tree: the rerouted requests carry
+        # BOTH wire attempts (a transport_error span and a served one)
+        deadline = time.monotonic() + 5.0
+        rerouted_trees = 0
+        while time.monotonic() < deadline:
+            doc = merge_fleet_trace(obs_trace.kept_trees(), {}, {})
+            settled = {
+                tid: spans for tid, spans in doc["traces"].items()
+                if any(s["name"] == "request" and s["hop"] == 0
+                       for s in spans)}
+            rerouted_trees = sum(
+                1 for spans in settled.values()
+                if any(s["name"] == "remote.wire"
+                       and s.get("args", {}).get("outcome")
+                       == "transport_error"
+                       for s in spans)
+                and any(s["name"] == "terminal.served" for s in spans))
+            if len(settled) >= n and rerouted_trees >= 1:
+                break
+            time.sleep(0.02)
+        assert len(settled) >= n
+        assert rerouted_trees >= 1, \
+            "no single tree holds both the failed and served attempts"
+        for tid, spans in settled.items():
+            assert tree_complete(spans), f"incomplete tree {tid}"
+    finally:
+        feed.close()
+        router.close()
+        _stop_agent(*agents[0][:2])
+
+
+# ---------------------------------------------------------------------------
+# adaptive pipeline depth (AIMD controller, synthetic clock)
+# ---------------------------------------------------------------------------
+
+def test_pipeline_controller_aimd_on_synthetic_rtts():
+    c = PipelineController(2, 8, clock=lambda: 0.0)
+    assert c.current() == 2
+
+    def interval(rtt, t_retune, full, reps=3):
+        if full:
+            c.note_full()
+        # samples inside the interval, then one that crosses it (the
+        # windowed p50 judges the OBSERVATION mix, so congested
+        # intervals feed more samples to dominate the window)
+        for _ in range(reps):
+            assert c.note_rtt(rtt, now=t_retune - 0.1) is False
+        assert c.note_rtt(rtt, now=t_retune) is True
+
+    # healthy + full → additive increase
+    interval(10.0, 0.30, full=True)
+    assert c.current() == 3
+    interval(10.0, 0.60, full=True)
+    assert c.current() == 4
+    # healthy but NOT full → no growth (no appetite signal)
+    interval(10.0, 0.90, full=False)
+    assert c.current() == 4
+    # sustained RTT blow-up over the window → multiplicative decrease
+    interval(200.0, 1.20, full=True, reps=24)
+    assert c.current() == 2
+    interval(200.0, 1.50, full=True, reps=24)
+    assert c.current() == 1
+    # at depth 1 queueing cannot be self-induced: the controller still
+    # probes upward when the pipeline filled, even under a congested
+    # verdict — refusing would pin the depth at 1 forever
+    interval(200.0, 1.80, full=True, reps=24)
+    assert c.current() == 2
+    assert c.depth_peak == 4
+    assert c.retunes == 6
+
+
+def test_pipeline_controller_clamps():
+    assert PipelineController(16, 4).current() == 4   # depth ≤ max
+    assert PipelineController(0, 4).current() == 1    # depth ≥ 1
+    c = PipelineController(4, 4, clock=lambda: 0.0)
+    c.note_full()
+    c.note_rtt(10.0, now=0.1)
+    c.note_rtt(10.0, now=0.3)
+    assert c.current() == 4                           # grow capped
+
+
+# ---------------------------------------------------------------------------
+# scraped-lane-hint staleness (satellite b)
+# ---------------------------------------------------------------------------
+
+def test_backlog_hints_decay_and_stamps_are_monotonic():
+    cfg = _cfg(crosshost__scrape_interval_s=0.1)   # ttl = 0.6 s
+    ag, srv, url = _start_agent(cfg)
+    eng = RemoteEngine("t-lanes", url, cfg)
+    try:
+        b = tuple(cfg.bucket.shapes[0])
+        assert eng.bucket_depth(b) == 0
+        assert eng.backlog_age() == float("inf")
+        now = time.monotonic()
+        # a snapshot already older than the ttl DECAYS at read time: a
+        # dead feed must not pin phantom depth that misroutes JSQ, and
+        # reading the depth never blocks on a scrape
+        eng.update_backlog({b: 3.0}, at=now - eng._lane_ttl_s - 0.1)
+        assert eng.bucket_depth(b) == 0
+        assert eng.backlog_age() > eng._lane_ttl_s
+        # a fresh snapshot replaces the stale one
+        eng.update_backlog({b: 5.0}, at=now)
+        assert eng.bucket_depth(b) == 5
+        assert eng.backlog_age() < 0.5
+        # an OLDER snapshot must never override a newer one...
+        eng.update_backlog({b: 99.0}, at=now - 0.2)
+        assert eng.bucket_depth(b) == 5
+        # ...and a future stamp is clamped to now (honest age)
+        eng.update_backlog({b: 7.0}, at=now + 100.0)
+        assert eng.bucket_depth(b) == 7
+        assert eng.backlog_age() < 1.0
+    finally:
+        eng.close()
+        _stop_agent(ag, srv)
